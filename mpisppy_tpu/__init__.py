@@ -1,0 +1,46 @@
+"""mpisppy_tpu — a TPU-native stochastic-programming framework.
+
+A ground-up re-design of the capabilities of mpi-sppy (scenario-based
+stochastic programming with Progressive Hedging and hub-and-spoke
+"cylinders") for TPU hardware: scenarios are a batch axis, per-scenario
+LP/QP subproblems are solved by a vmapped first-order PDHG kernel on the
+MXU, and MPI collectives become XLA collectives (`psum` over a named
+scenario mesh axis under `shard_map`).
+
+Reference parity: mirrors the layer map of mpi-sppy (see SURVEY.md §1);
+the bootstrap/timing layer here corresponds to mpisppy/__init__.py:4-13
+in the reference.
+"""
+
+import time as _time
+
+__version__ = "0.1.0"
+
+_T0 = _time.time()
+_TOC_ENABLED = True
+
+
+def global_toc(msg, cond=True):
+    """Timestamped trace line (reference: mpisppy/__init__.py:11 global_toc).
+
+    `cond` is typically `rank == 0`; in the single-controller JAX world it
+    defaults to True (one python process drives all devices).
+    """
+    if cond and _TOC_ENABLED:
+        print(f"[{_time.time() - _T0:10.2f}] {msg}", flush=True)
+
+
+def disable_tictoc_output():
+    """Reference: sputils.disable_tictoc_output (sputils.py:914)."""
+    global _TOC_ENABLED
+    _TOC_ENABLED = False
+
+
+def reenable_tictoc_output():
+    """Reference: sputils.reenable_tictoc_output (sputils.py:918)."""
+    global _TOC_ENABLED
+    _TOC_ENABLED = True
+
+
+tt_timer = global_toc  # name-compat with the reference's tt_timer
+haveMPI = False  # we never have MPI; the collective layer is XLA

@@ -1,0 +1,250 @@
+"""Array problem IR — the TPU-native replacement for the Pyomo scenario layer.
+
+In the reference, a scenario is a Pyomo ConcreteModel produced by a user
+`scenario_creator` callback, with tree metadata attached as
+`_mpisppy_node_list` / `_mpisppy_probability`
+(reference: mpisppy/spbase.py:505-522, mpisppy/scenario_tree.py:44).
+Solvers then consume the Pyomo model out-of-process.
+
+Here a scenario is lowered ONCE at creation time to dense arrays
+
+    minimize   c @ x + 0.5 * x @ diag(qdiag) @ x + obj_const
+    subject to row_lo <= A @ x <= row_hi
+               lb <= x <= ub
+
+and N scenarios are stacked into a `ScenarioBatch` pytree with a leading
+scenario axis — the "DP axis" of stochastic programming
+(SURVEY.md §2.10).  Everything downstream (PH, bounds, xhat evaluation)
+is a vmapped/sharded computation over that axis.
+
+Shapes must agree across scenarios in one batch (pad rows with free
+bounds if a scenario has fewer constraints).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _register(cls, data_fields, meta_fields):
+    jax.tree_util.register_dataclass(
+        cls, data_fields=data_fields, meta_fields=meta_fields
+    )
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class TreeInfo:
+    """Scenario-tree metadata for one batch (reference: scenario_tree.py:44
+    ScenarioNode + sputils._ScenTree at sputils.py:745).
+
+    Nonanticipative ("nonant") variables are the per-scenario slots that
+    must agree across scenarios sharing a tree node.  They are laid out
+    stage-major inside each scenario's x-vector via `nonant_idx`.
+
+    node_of[s, j] = global node id owning nonant slot j of scenario s.
+    For a two-stage problem every entry is 0 (the ROOT node).
+    Per-node consensus (Compute_Xbar) is a segment-sum over node ids —
+    the TPU analog of the reference's per-tree-node MPI communicators
+    (spbase.py:333-375).
+    """
+
+    # (S, K) int32: global node id per scenario per nonant slot
+    node_of: Any
+    # (S,) float: unconditional scenario probability
+    prob: Any
+    # number of distinct nodes (static, for segment_sum sizing)
+    num_nodes: int = 1
+    # (K,) int32 stage (1-based) of each nonant slot; static metadata
+    stage_of: Any = None
+    # names for reporting (static)
+    nonant_names: tuple = ()
+    scen_names: tuple = ()
+
+
+_register(
+    TreeInfo,
+    data_fields=("node_of", "prob"),
+    meta_fields=("num_nodes", "stage_of", "nonant_names", "scen_names"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioBatch:
+    """A batch of S lowered scenario subproblems (leading axis = scenario).
+
+    The lowering replaces the reference's per-iteration Pyomo objective
+    mutation (phbase.py:585-699 attach_Ws_and_prox/attach_PH_to_objective):
+    PH's W and prox terms enter as pure array arguments to the solver
+    kernel, never touching this static problem data.
+    """
+
+    c: Any          # (S, N) linear objective
+    qdiag: Any      # (S, N) diagonal quadratic objective (0 for LP)
+    A: Any          # (S, M, N) constraints
+    row_lo: Any     # (S, M)
+    row_hi: Any     # (S, M)
+    lb: Any         # (S, N)
+    ub: Any         # (S, N)
+    obj_const: Any  # (S,)
+    nonant_idx: Any  # (K,) int32 — same layout for all scenarios
+    integer_mask: Any  # (S, N) bool
+    tree: TreeInfo
+    # (n_stages, S, N): per-stage objective coefficient split, for
+    # FirstStageCost-style reporting (reference cost_expression per node);
+    # optional — None when not provided.
+    stage_cost_c: Any = None
+    var_names: tuple = ()   # static, length N (reporting only)
+
+    @property
+    def num_scens(self):
+        return self.c.shape[0]
+
+    @property
+    def num_vars(self):
+        return self.c.shape[1]
+
+    @property
+    def num_rows(self):
+        return self.A.shape[1]
+
+    @property
+    def num_nonants(self):
+        return self.nonant_idx.shape[0]
+
+    @property
+    def prob(self):
+        return self.tree.prob
+
+    def nonants(self, x):
+        """Extract nonant slots from a (..., N) solution -> (..., K)."""
+        return jnp.take(x, self.nonant_idx, axis=-1)
+
+    def objective(self, x):
+        """Per-scenario objective value of a (S, N) primal point -> (S,)."""
+        return (
+            jnp.sum(self.c * x, axis=-1)
+            + 0.5 * jnp.sum(self.qdiag * x * x, axis=-1)
+            + self.obj_const
+        )
+
+
+_register(
+    ScenarioBatch,
+    data_fields=(
+        "c", "qdiag", "A", "row_lo", "row_hi", "lb", "ub", "obj_const",
+        "nonant_idx", "integer_mask", "tree", "stage_cost_c",
+    ),
+    meta_fields=("var_names",),
+)
+
+
+def stack_scenarios(scens, scen_names=None):
+    """Stack a list of single-scenario dicts/batches (S=1 each) into one
+    ScenarioBatch.  Mirrors SPBase._create_scenarios looping the user's
+    scenario_creator (reference spbase.py:255-273), then normalizes
+    probabilities the way _compute_unconditional_node_probabilities does
+    (spbase.py:378-392).
+    """
+    if not scens:
+        raise ValueError("no scenarios to stack")
+    first = scens[0]
+    if any(s.num_vars != first.num_vars or s.num_rows != first.num_rows
+           for s in scens):
+        raise ValueError(
+            "all scenarios in a batch must share (num_rows, num_vars); "
+            "pad constraint rows with free bounds to equalize"
+        )
+    # nonant layout must be identical — the consensus average pairs slot
+    # j across scenarios (reference counterpart: _verify_nonant_lengths,
+    # spbase.py:150)
+    ref_idx = np.asarray(first.nonant_idx)
+    for s in scens[1:]:
+        if not np.array_equal(np.asarray(s.nonant_idx), ref_idx):
+            raise ValueError(
+                "all scenarios must declare the same nonant variable "
+                "layout (indices and order)")
+
+    def cat(field):
+        return jnp.concatenate([getattr(s, field) for s in scens], axis=0)
+
+    prob = jnp.concatenate([s.tree.prob for s in scens])
+    total = jnp.sum(prob)
+    prob = prob / total
+    node_of = jnp.concatenate([s.tree.node_of for s in scens], axis=0)
+    num_nodes = max(s.tree.num_nodes for s in scens)
+    names = tuple(scen_names) if scen_names is not None else tuple(
+        n for s in scens for n in (s.tree.scen_names or ("?",) * s.num_scens)
+    )
+    tree = TreeInfo(
+        node_of=node_of,
+        prob=prob,
+        num_nodes=num_nodes,
+        stage_of=first.tree.stage_of,
+        nonant_names=first.tree.nonant_names,
+        scen_names=names,
+    )
+    stage_cost_c = None
+    if first.stage_cost_c is not None:
+        stage_cost_c = jnp.concatenate(
+            [s.stage_cost_c for s in scens], axis=1)
+    return ScenarioBatch(
+        c=cat("c"), qdiag=cat("qdiag"), A=cat("A"),
+        row_lo=cat("row_lo"), row_hi=cat("row_hi"),
+        lb=cat("lb"), ub=cat("ub"), obj_const=cat("obj_const"),
+        nonant_idx=first.nonant_idx,
+        integer_mask=cat("integer_mask"),
+        tree=tree,
+        stage_cost_c=stage_cost_c,
+        var_names=first.var_names,
+    )
+
+
+def pad_scenarios(batch: ScenarioBatch, to: int) -> ScenarioBatch:
+    """Pad a batch with zero-probability dummy scenarios so S divides the
+    device count.  The sharding layer requires equal shards per device —
+    the analog of the reference's contiguous scenario slices per rank
+    (sputils.py:804-812), which tolerate ragged slice sizes; we instead
+    pad and let probability-0 entries vanish from every reduction.
+    """
+    S = batch.num_scens
+    if to <= S:
+        return batch
+    padn = to - S
+
+    def padfield(v, fill=0.0):
+        pad_shape = (padn,) + v.shape[1:]
+        return jnp.concatenate([v, jnp.full(pad_shape, fill, v.dtype)], axis=0)
+
+    tree = batch.tree
+    new_tree = TreeInfo(
+        node_of=padfield(tree.node_of, 0),
+        prob=padfield(tree.prob, 0.0),
+        num_nodes=tree.num_nodes,
+        stage_of=tree.stage_of,
+        nonant_names=tree.nonant_names,
+        scen_names=tree.scen_names + tuple(
+            f"_pad{i}" for i in range(padn)),
+    )
+    # Dummy scenarios: feasible-by-construction (free rows, unit box).
+    return ScenarioBatch(
+        c=padfield(batch.c),
+        qdiag=padfield(batch.qdiag),
+        A=padfield(batch.A),
+        row_lo=padfield(batch.row_lo, -np.inf),
+        row_hi=padfield(batch.row_hi, np.inf),
+        lb=padfield(batch.lb),
+        ub=padfield(batch.ub, 1.0),
+        obj_const=padfield(batch.obj_const),
+        nonant_idx=batch.nonant_idx,
+        integer_mask=padfield(batch.integer_mask, False),
+        tree=new_tree,
+        stage_cost_c=None if batch.stage_cost_c is None else jnp.pad(
+            batch.stage_cost_c, ((0, 0), (0, padn), (0, 0))),
+        var_names=batch.var_names,
+    )

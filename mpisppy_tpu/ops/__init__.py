@@ -1,0 +1,1 @@
+from .pdhg import PDHGSolver, SolveResult, prepare_batch  # noqa: F401

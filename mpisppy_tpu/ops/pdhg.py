@@ -1,0 +1,406 @@
+"""Batched first-order LP/QP solver (restarted PDHG, PDLP/MPAX family).
+
+This kernel is the TPU-native replacement for the reference's
+out-of-process Gurobi/CPLEX/Xpress calls (reference: mpisppy/spopt.py:85
+`solve_one`, :839 `_create_solvers`) — SURVEY.md §2.9.  One scenario =
+one batch element; all matvecs are batched (S, M, N) x (S, N) einsums
+that land on the MXU; the whole solve is one `lax.while_loop` under
+`jit`, so PH's solve_loop becomes a single fused XLA computation instead
+of N sequential solver processes.
+
+Problem form (per scenario):
+
+    minimize    c @ x + 0.5 * qdiag @ (x*x)
+    subject to  row_lo <= A @ x <= row_hi
+                lb <= x <= ub
+
+qdiag >= 0 (diagonal QP — exactly what PH's proximal term produces,
+reference phbase.py:617 attach_PH_to_objective).
+
+Method: Chambolle-Pock / Condat-Vu primal-dual iterations with
+  * Ruiz equilibration of A (done once per batch in `prepare_batch`),
+  * step sizes from a power-iteration estimate of ||A||_2,
+  * fixed-frequency restart to the running average iterate, keeping
+    whichever of {current, average} has the smaller KKT error
+    (the PDLP restart scheme, simplified),
+  * primal-weight (omega) rebalancing at restarts,
+  * per-scenario convergence freezing.
+
+Termination mirrors PDLP's relative KKT criterion.  Duals: `y` are the
+row multipliers; `reduced costs` follow from c + qdiag*x + A^T y, giving
+the Lagrangian-bound machinery its inputs (reference
+cylinders/lagrangian_bounder.py) for free — see `dual_objective`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _register(cls, data_fields, meta_fields=()):
+    jax.tree_util.register_dataclass(
+        cls, data_fields=data_fields, meta_fields=meta_fields)
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedBatch:
+    """Scaled constraint data, computed once per ScenarioBatch."""
+    A: Any        # (S, M, N) scaled: D_r @ A @ D_c
+    row_lo: Any   # (S, M) scaled: D_r * row_lo
+    row_hi: Any   # (S, M)
+    d_row: Any    # (S, M) row scaling D_r
+    d_col: Any    # (S, N) col scaling D_c
+    anorm: Any    # (S,) ||A_scaled||_2 estimate
+
+
+_register(PreparedBatch,
+          ("A", "row_lo", "row_hi", "d_row", "d_col", "anorm"))
+
+
+@dataclasses.dataclass(frozen=True)
+class SolveResult:
+    x: Any          # (S, N) primal solution (unscaled)
+    y: Any          # (S, M) row duals (unscaled)
+    obj: Any        # (S,) primal objective (incl. obj_const)
+    dual_obj: Any   # (S,) dual objective estimate (incl. obj_const)
+    pres: Any       # (S,) relative primal residual (inf-norm)
+    dres: Any       # (S,) relative dual residual (inf-norm)
+    gap: Any        # (S,) relative primal-dual gap
+    converged: Any  # (S,) bool
+    iters: Any      # () int - iterations used (max across batch)
+
+
+_register(SolveResult,
+          ("x", "y", "obj", "dual_obj", "pres", "dres", "gap",
+           "converged", "iters"))
+
+
+# --------------------------------------------------------------------------
+# scaling
+# --------------------------------------------------------------------------
+
+def _ruiz(A, n_iter=10, eps=1e-12):
+    """Ruiz equilibration: returns (A_scaled, d_row, d_col) with
+    A_scaled = diag(d_row) @ A @ diag(d_col), rows/cols ~unit inf-norm."""
+    S, M, N = A.shape
+    d_row = jnp.ones((S, M), A.dtype)
+    d_col = jnp.ones((S, N), A.dtype)
+
+    def body(_, carry):
+        As, dr, dc = carry
+        rmax = jnp.max(jnp.abs(As), axis=2)            # (S, M)
+        cmax = jnp.max(jnp.abs(As), axis=1)            # (S, N)
+        sr = 1.0 / jnp.sqrt(jnp.maximum(rmax, eps))
+        sc = 1.0 / jnp.sqrt(jnp.maximum(cmax, eps))
+        sr = jnp.where(rmax <= eps, 1.0, sr)
+        sc = jnp.where(cmax <= eps, 1.0, sc)
+        As = As * sr[:, :, None] * sc[:, None, :]
+        return As, dr * sr, dc * sc
+
+    A, d_row, d_col = lax.fori_loop(0, n_iter, body, (A, d_row, d_col))
+    return A, d_row, d_col
+
+
+def _power_iteration(A, iters=40, seed=0):
+    """||A||_2 per scenario via power iteration on A^T A."""
+    S, M, N = A.shape
+    key = jax.random.PRNGKey(seed)
+    v = jax.random.normal(key, (S, N), A.dtype)
+
+    def body(_, v):
+        v = v / (jnp.linalg.norm(v, axis=1, keepdims=True) + 1e-30)
+        av = jnp.einsum("smn,sn->sm", A, v)
+        v = jnp.einsum("smn,sm->sn", A, av)
+        return v
+
+    v = lax.fori_loop(0, iters, body, v)
+    av = jnp.einsum("smn,sn->sm", A, v / (
+        jnp.linalg.norm(v, axis=1, keepdims=True) + 1e-30))
+    return jnp.linalg.norm(av, axis=1)
+
+
+@partial(jax.jit, static_argnames=("ruiz_iters",))
+def prepare_batch(A, row_lo, row_hi, ruiz_iters=10):
+    """One-time per-batch preprocessing (scale + norm estimate)."""
+    As, d_row, d_col = _ruiz(A, n_iter=ruiz_iters)
+    anorm = _power_iteration(As)
+    return PreparedBatch(
+        A=As,
+        row_lo=jnp.where(jnp.isfinite(row_lo), row_lo * d_row, row_lo),
+        row_hi=jnp.where(jnp.isfinite(row_hi), row_hi * d_row, row_hi),
+        d_row=d_row,
+        d_col=d_col,
+        # floor at 1: after Ruiz scaling a real A has ||A|| >= ~1; an
+        # all-zero A (zero-probability padding scenario, ir.pad_scenarios)
+        # would otherwise yield ~0 and blow up the step sizes
+        anorm=jnp.maximum(anorm, 1.0),
+    )
+
+
+# --------------------------------------------------------------------------
+# core iteration pieces (all batched over leading S axis)
+# --------------------------------------------------------------------------
+
+def _proj_box(x, lb, ub):
+    return jnp.clip(x, lb, ub)
+
+
+def _dual_prox(v, sigma, lo, hi):
+    """prox of the support function of [lo, hi]:
+    v - sigma * proj_[lo,hi](v / sigma), safe with +-inf bounds."""
+    z = v / sigma[..., None]
+    zc = jnp.clip(z, lo, hi)
+    return v - sigma[..., None] * zc
+
+
+def _residuals(x, y, c, qdiag, A, row_lo, row_hi, lb, ub):
+    """KKT residuals + gap, all relative, inf-norms. Batched.
+
+    Follows the PDLP convention: reduced-cost terms whose matching bound
+    is infinite are projected out of the dual objective and charged to
+    the dual residual instead.
+    """
+    Ax = jnp.einsum("smn,sn->sm", A, x)
+    # primal violation of row bounds (box is enforced by projection)
+    pviol = jnp.maximum(jnp.maximum(row_lo - Ax, Ax - row_hi), 0.0)
+    pviol = jnp.where(jnp.isfinite(pviol), pviol, 0.0)
+    rhs_scale = 1.0 + jnp.max(
+        jnp.where(jnp.isfinite(row_hi), jnp.abs(row_hi), 0.0)
+        + jnp.where(jnp.isfinite(row_lo), jnp.abs(row_lo), 0.0), axis=1)
+    pres = jnp.max(pviol, axis=1) / rhs_scale
+
+    # dual: r = grad f + A^T y ; must live in normal cone of the box
+    grad = c + qdiag * x
+    aty = jnp.einsum("smn,sm->sn", A, y)
+    r = grad + aty
+    # split reduced cost by sign; valid part pairs with a finite bound
+    rpos = jnp.maximum(r, 0.0)
+    rneg = jnp.minimum(r, 0.0)
+    lb_fin = jnp.isfinite(lb)
+    ub_fin = jnp.isfinite(ub)
+    # dual residual: the part of r that cannot be explained by an active
+    # finite bound
+    dviol = jnp.where(lb_fin, 0.0, rpos) + jnp.where(ub_fin, 0.0, -rneg)
+    # plus stationarity leftover for strictly-interior coords:
+    at_lb = x <= lb + 1e-9 * (1 + jnp.abs(lb))
+    at_ub = x >= ub - 1e-9 * (1 + jnp.abs(ub))
+    interior = ~(at_lb | at_ub)
+    dviol = jnp.maximum(dviol, jnp.where(interior, jnp.abs(r), 0.0))
+    obj_scale = 1.0 + jnp.max(jnp.abs(c), axis=1)
+    dres = jnp.max(dviol, axis=1) / obj_scale
+
+    # objectives
+    pobj = jnp.sum(c * x, axis=1) + 0.5 * jnp.sum(qdiag * x * x, axis=1)
+    # dual objective (PDLP-style estimate):
+    #   g(y) = -0.5 x'Qx - sup_{s in [lo,hi]} y's + sum_j rc_j * (lb or ub)
+    # with L = f(x) + y'(Ax) - sup_{s in [lo,hi]} y's, the support term
+    # is y_i*hi if y_i>0 else y_i*lo.
+    ysup = jnp.where(y > 0,
+                     jnp.where(jnp.isfinite(row_hi), y * row_hi, 0.0),
+                     jnp.where(jnp.isfinite(row_lo), y * row_lo, 0.0))
+    rc = jnp.where(lb_fin, rpos * lb, 0.0) + jnp.where(ub_fin, rneg * ub, 0.0)
+    dobj = (-0.5 * jnp.sum(qdiag * x * x, axis=1)
+            - jnp.sum(ysup, axis=1)
+            + jnp.sum(rc, axis=1))
+    gap = jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+    return pres, dres, gap, pobj, dobj
+
+
+@dataclasses.dataclass(frozen=True)
+class _Carry:
+    x: Any
+    y: Any
+    x_sum: Any      # running sums for the restart average
+    y_sum: Any
+    nsum: Any       # scalar count in current restart cycle
+    x_last: Any     # iterate at last restart (for omega update)
+    y_last: Any
+    omega: Any      # (S,) primal weight
+    k: Any          # iteration counter
+    converged: Any  # (S,) bool
+    x_best: Any     # frozen solution for converged scenarios
+    y_best: Any
+
+
+_register(_Carry, tuple(f.name for f in dataclasses.fields(_Carry)))
+
+
+class PDHGSolver:
+    """Restarted PDHG solver over a ScenarioBatch.
+
+    Stateless/functional: `solve` is jit-compiled; typical use is through
+    SPOpt.solve_loop (opt/spopt.py) which supplies PH-modified
+    objectives as plain arrays.
+    """
+
+    def __init__(self, max_iters=20000, eps=1e-6, check_every=40,
+                 restart_every=4, omega0=1.0):
+        # restart_every is in units of `check_every` inner iterations
+        self.max_iters = int(max_iters)
+        self.eps = float(eps)
+        self.check_every = int(check_every)
+        self.restart_every = int(restart_every)
+        self.omega0 = float(omega0)
+        self._solve_jit = jax.jit(self._solve_impl)
+
+    # -- public ----------------------------------------------------------
+    def solve(self, prep: PreparedBatch, c, qdiag, lb, ub,
+              obj_const=None, x0=None, y0=None) -> SolveResult:
+        """Solve the batch.  c/qdiag/lb/ub are UNSCALED user-space arrays
+        (S, N); x0/y0 optional warm starts in user space."""
+        S, N = c.shape
+        M = prep.A.shape[1]
+        if obj_const is None:
+            obj_const = jnp.zeros((S,), c.dtype)
+        if x0 is None:
+            x0 = jnp.zeros((S, N), c.dtype)
+        if y0 is None:
+            y0 = jnp.zeros((S, M), c.dtype)
+        return self._solve_jit(prep, c, qdiag, lb, ub, obj_const, x0, y0)
+
+    # -- impl --------------------------------------------------------
+    def _solve_impl(self, prep, c, qdiag, lb, ub, obj_const, x0, y0):
+        dc, dr = prep.d_col, prep.d_row
+        # scale into solver space
+        cs = c * dc
+        qs = qdiag * dc * dc
+        lbs = jnp.where(jnp.isfinite(lb), lb / dc, lb)
+        ubs = jnp.where(jnp.isfinite(ub), ub / dc, ub)
+        xs0 = jnp.clip(jnp.where(jnp.isfinite(x0 / dc), x0 / dc, 0.0),
+                       lbs, ubs)
+        ys0 = y0 / dr
+        A, rlo, rhi = prep.A, prep.row_lo, prep.row_hi
+        anorm = prep.anorm
+        qmax = jnp.max(qs, axis=1)
+        # clamp the tolerance to what the dtype can express: in float32
+        # an eps below ~1e-5 can never be met and every solve would spin
+        # to max_iters
+        eps = max(self.eps, 100.0 * float(jnp.finfo(cs.dtype).eps))
+
+        def steps(x, y, omega, n):
+            """n PDHG iterations; returns final + running sums."""
+            sigma = 0.9 * omega / anorm
+            tau = 0.9 / (omega * anorm + 0.9 * qmax)
+
+            def body(_, carry):
+                x, y, xs, ys = carry
+                grad = cs + qs * x + jnp.einsum("smn,sm->sn", A, y)
+                xn = _proj_box(x - tau[:, None] * grad, lbs, ubs)
+                xt = 2.0 * xn - x
+                v = y + sigma[:, None] * jnp.einsum("smn,sn->sm", A, xt)
+                yn = _dual_prox(v, sigma, rlo, rhi)
+                return xn, yn, xs + xn, ys + yn
+
+            zx = jnp.zeros_like(x)
+            zy = jnp.zeros_like(y)
+            x, y, xs, ys = lax.fori_loop(0, n, body, (x, y, zx, zy))
+            return x, y, xs, ys
+
+        def kkt_score(x, y):
+            pres, dres, gap, _, _ = _residuals(
+                x, y, cs, qs, A, rlo, rhi, lbs, ubs)
+            return pres + dres + gap, pres, dres, gap
+
+        ne = self.check_every
+        n_outer = self.max_iters // ne
+
+        def cond(carry):
+            return (carry.k < n_outer) & (~jnp.all(carry.converged))
+
+        def body(carry):
+            x, y, xs, ys = steps(carry.x, carry.y, carry.omega, ne)
+            x_sum = carry.x_sum + xs
+            y_sum = carry.y_sum + ys
+            nsum = carry.nsum + ne
+            score_cur, pres, dres, gap = kkt_score(x, y)
+            newly = (pres < eps) & (dres < eps) & (gap < eps)
+            conv = carry.converged | newly
+            x_best = jnp.where(
+                (newly & ~carry.converged)[:, None], x, carry.x_best)
+            y_best = jnp.where(
+                (newly & ~carry.converged)[:, None], y, carry.y_best)
+
+            k = carry.k + 1
+            do_restart = (k % self.restart_every) == 0
+
+            def restart(_):
+                xa = x_sum / nsum
+                ya = y_sum / nsum
+                score_avg, *_ = kkt_score(xa, ya)
+                take_avg = score_avg < score_cur
+                xr = jnp.where(take_avg[:, None], xa, x)
+                yr = jnp.where(take_avg[:, None], ya, y)
+                # primal weight update (PDLP eq. (10)-style smoothing)
+                dx = jnp.linalg.norm(xr - carry.x_last, axis=1)
+                dy = jnp.linalg.norm(yr - carry.y_last, axis=1)
+                ok = (dx > 1e-12) & (dy > 1e-12)
+                ratio = jnp.where(ok, dy / jnp.maximum(dx, 1e-12), 1.0)
+                omega = jnp.where(
+                    ok,
+                    jnp.exp(0.5 * jnp.log(ratio)
+                            + 0.5 * jnp.log(carry.omega)),
+                    carry.omega)
+                omega = jnp.clip(omega, 1e-4, 1e4)
+                z = jnp.zeros_like(x)
+                return xr, yr, z, jnp.zeros_like(y), 0.0, xr, yr, omega
+
+            def norestart(_):
+                return (x, y, x_sum, y_sum, nsum,
+                        carry.x_last, carry.y_last, carry.omega)
+
+            (xr, yr, xsr, ysr, nsr, xl, yl, om) = lax.cond(
+                do_restart, restart, norestart, None)
+
+            # freeze converged scenarios
+            cm = carry.converged[:, None]
+            return _Carry(
+                x=jnp.where(cm, carry.x, xr),
+                y=jnp.where(cm, carry.y, yr),
+                x_sum=xsr, y_sum=ysr, nsum=nsr,
+                x_last=xl, y_last=yl, omega=om, k=k,
+                converged=conv, x_best=x_best, y_best=y_best)
+
+        S, N = cs.shape
+        M = rlo.shape[1]
+        init = _Carry(
+            x=xs0, y=ys0,
+            x_sum=jnp.zeros_like(xs0), y_sum=jnp.zeros_like(ys0),
+            nsum=jnp.asarray(0.0, cs.dtype),
+            x_last=xs0, y_last=ys0,
+            omega=jnp.full((S,), self.omega0, cs.dtype),
+            k=jnp.asarray(0, jnp.int32),
+            converged=jnp.zeros((S,), bool),
+            x_best=xs0, y_best=ys0)
+        fin = lax.while_loop(cond, body, init)
+
+        x = jnp.where(fin.converged[:, None], fin.x_best, fin.x)
+        y = jnp.where(fin.converged[:, None], fin.y_best, fin.y)
+        pres, dres, gap, _, _ = _residuals(
+            x, y, cs, qs, A, rlo, rhi, lbs, ubs)
+        # unscale
+        xu = x * dc
+        yu = y * dr
+        pobj = (jnp.sum(c * xu, axis=1)
+                + 0.5 * jnp.sum(qdiag * xu * xu, axis=1) + obj_const)
+        # dual objective in user space (recompute residual pieces unscaled)
+        _, _, _, _, dobj = _residuals(
+            xu, yu, c, qdiag,
+            prep.A / dr[:, :, None] / dc[:, None, :],
+            jnp.where(jnp.isfinite(prep.row_lo), prep.row_lo / dr,
+                      prep.row_lo),
+            jnp.where(jnp.isfinite(prep.row_hi), prep.row_hi / dr,
+                      prep.row_hi),
+            lb, ub)
+        return SolveResult(
+            x=xu, y=yu, obj=pobj, dual_obj=dobj + obj_const,
+            pres=pres, dres=dres, gap=gap,
+            converged=fin.converged | ((pres < eps) & (dres < eps)
+                                       & (gap < eps)),
+            iters=fin.k * ne)

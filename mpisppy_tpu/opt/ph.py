@@ -1,0 +1,24 @@
+"""PH — the Progressive Hedging driver (reference: mpisppy/opt/ph.py).
+
+ph_main mirrors the reference pipeline (opt/ph.py:25-71):
+PH_Prep -> Iter0 -> iterk_loop -> post_loops, returning
+(conv, Eobj, trivial_bound).
+"""
+
+from __future__ import annotations
+
+from .. import global_toc
+from ..phbase import PHBase
+
+
+class PH(PHBase):
+    def ph_main(self, finalize=True):
+        self.trivial_bound = None
+        trivial = self.Iter0()
+        self.iterk_loop()
+        if finalize:
+            eobj = self.post_loops()
+            global_toc(f"PH done: conv={self.conv:.4e} Eobj={eobj:.6g} "
+                       f"trivial_bound={trivial:.6g}")
+            return self.conv, eobj, trivial
+        return self.conv, None, trivial

@@ -1,0 +1,274 @@
+"""PHBase — Progressive Hedging machinery (reference: mpisppy/phbase.py).
+
+The reference's per-iteration work is: pack [xbar||xsqbar] vectors
+var-by-var into Pyomo Params, one MPI Allreduce per tree node
+(phbase.py:27-107 _Compute_Xbar), a Python loop for the dual update
+(:293-318 Update_W), mutation of every scenario's Pyomo objective, and
+N sequential solver calls.  Here ALL of it is one jitted superstep:
+
+    x  <- argmin_x  c@x + (W - rho*xbar)@x_na + rho/2 ||x_na||^2 + ...
+    xbar <- per-node probability-weighted average (segment-sum + psum)
+    W  <- W + rho * (x_na - xbar)
+    conv <- prob-weighted scaled ||x - xbar||_1
+
+The per-tree-node communicators of the reference (spbase.py:333-375)
+become a segment-sum over node ids (ir.TreeInfo.node_of) — identical
+code for 2-stage (1 node) and multistage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import global_toc
+from .ir import ScenarioBatch
+from .spopt import SPOpt
+
+
+def _register(cls, data_fields, meta_fields=()):
+    jax.tree_util.register_dataclass(
+        cls, data_fields=data_fields, meta_fields=meta_fields)
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class PHState:
+    """Per-iteration PH state (pytree; scenario-leading arrays sharded)."""
+    x: Any        # (S, N) last primal solutions
+    y: Any        # (S, M) last duals (warm start + Lagrangian bounds)
+    W: Any        # (S, K) dual weights on nonants
+    xbar: Any     # (S, K) per-slot consensus values (node-averaged)
+    xsqbar: Any   # (S, K) consensus of squares (for Fixer-style variance)
+    obj: Any      # (S,) per-scenario objective at x
+    dual_obj: Any  # (S,)
+    conv: Any     # () convergence metric
+    it: Any       # () int iteration count
+
+
+_register(PHState, tuple(f.name for f in dataclasses.fields(PHState)))
+
+
+# ---- pure functional core (all jit-friendly) -----------------------------
+
+def compute_xbar(batch: ScenarioBatch, x_na, extra=None):
+    """Per-node probability-weighted averages of nonant values.
+
+    Mirror of _Compute_Xbar (reference phbase.py:27-107): the reference
+    packs [xbar||xsqbar] and Allreduces per node comm; here it's a
+    segment-sum over node ids, reduced across devices by XLA.
+
+    x_na: (S, K) nonant values.  Returns (xbar, xsqbar), each (S, K),
+    gathered back to scenario-slot layout.
+    """
+    tree = batch.tree
+    node_of = tree.node_of                       # (S, K)
+    p = tree.prob[:, None]                       # (S, 1)
+    K = x_na.shape[1]
+    nn = tree.num_nodes
+    cols = jnp.broadcast_to(jnp.arange(K)[None, :], node_of.shape)
+    flatid = node_of * K + cols                  # (S, K) segment keys
+
+    def nodesum(v):
+        z = jnp.zeros((nn * K,), v.dtype)
+        return z.at[flatid.reshape(-1)].add(v.reshape(-1))
+
+    wsum = nodesum(jnp.broadcast_to(p, x_na.shape))
+    xsum = nodesum(p * x_na)
+    xsqsum = nodesum(p * x_na * x_na)
+    denom = jnp.maximum(wsum, 1e-30)
+    xbar_nodes = xsum / denom
+    xsqbar_nodes = xsqsum / denom
+    xbar = xbar_nodes[flatid]
+    xsqbar = xsqbar_nodes[flatid]
+    return xbar, xsqbar
+
+
+def ph_objective_arrays(batch: ScenarioBatch, W, rho, xbar,
+                        W_on=1.0, prox_on=1.0):
+    """Fold PH's W and prox terms into (c_eff, qdiag_eff).
+
+    Replaces attach_Ws_and_prox / attach_PH_to_objective (reference
+    phbase.py:585-699): W@x + prox_on * rho/2 (x^2 - 2 xbar x + xbar^2).
+    The xbar^2 constant is dropped (doesn't move the argmin; objective
+    values reported from c, not c_eff).  W_on/prox_on mirror the
+    reference's gate scalars.
+    """
+    na = batch.nonant_idx
+    lin = W_on * W - prox_on * rho * xbar
+    c_eff = batch.c.at[:, na].add(lin)
+    q_eff = batch.qdiag.at[:, na].add(
+        jnp.broadcast_to(prox_on * rho, W.shape))
+    return c_eff, q_eff
+
+
+def update_W(W, rho, x_na, xbar):
+    """Dual update (reference phbase.py:293-318 Update_W)."""
+    return W + rho * (x_na - xbar)
+
+
+def convergence_metric(batch: ScenarioBatch, x_na, xbar):
+    """Scaled prob-weighted ||x - xbar||_1 (reference phbase.py:321-343
+    convergence_diff)."""
+    K = max(x_na.shape[1], 1)
+    per_scen = jnp.sum(jnp.abs(x_na - xbar), axis=1) / K
+    return jnp.sum(batch.prob * per_scen)
+
+
+class PHBase(SPOpt):
+    """Shared PH machinery; algorithm drivers (opt/ph.py, opt/aph.py)
+    subclass this."""
+
+    def __init__(self, options, all_scenario_names, scenario_creator=None,
+                 scenario_denouement=None, all_nodenames=None,
+                 extensions=None, extension_kwargs=None,
+                 rho_setter=None, variable_probability=None,
+                 scenario_creator_kwargs=None, batch=None, mesh=None):
+        super().__init__(
+            options, all_scenario_names,
+            scenario_creator=scenario_creator,
+            scenario_denouement=scenario_denouement,
+            all_nodenames=all_nodenames,
+            scenario_creator_kwargs=scenario_creator_kwargs,
+            variable_probability=variable_probability,
+            batch=batch, mesh=mesh)
+        self.rho_setter = rho_setter
+        self.extobject = None
+        if extensions is not None:
+            self.extobject = extensions(self, **(extension_kwargs or {}))
+        self.spcomm = None  # set by cylinders.hub when running as hub
+        self._iter0_solver_options = self.options.get(
+            "iter0_solver_options")
+        self.W_on = 1.0
+        self.prox_on = 1.0
+
+        # rho: scalar option -> (S, K) array; rho_setter may override
+        # per-variable (reference phbase.py:387-406 _use_rho_setter)
+        K = self.batch.num_nonants
+        S = self.batch.num_scens
+        rho_default = float(self.options.get("defaultPHrho", 1.0))
+        rho = jnp.full((S, K), rho_default, self.batch.c.dtype)
+        if rho_setter is not None:
+            vals = np.asarray(rho_setter(self.batch), dtype=float)
+            rho = jnp.broadcast_to(jnp.asarray(vals), (S, K)).astype(
+                self.batch.c.dtype)
+        self.rho = rho
+
+        self.state: PHState | None = None
+        self.trivial_bound = None
+        self.best_bound = None
+        self._superstep = jax.jit(self._superstep_impl)
+        self.conv = None
+
+    # -- hook plumbing (reference extensions/extension.py API) ------------
+    def _ext(self, hook):
+        if self.extobject is not None:
+            getattr(self.extobject, hook, lambda: None)()
+
+    # -- Iter0 (reference phbase.py:758-872) ------------------------------
+    def Iter0(self):
+        self._ext("pre_iter0")
+        global_toc("Iter0: no-penalty solves")
+        res = self.solve_loop(warm=False,
+                              dtiming=self.options.get("display_timing"))
+        feas = self.feas_prob(res)
+        if feas < 1.0 - 1e-6:
+            # reference hard-quits on infeasible iter0 (phbase.py:817)
+            global_toc(f"WARNING: iter0 feasible mass only {feas}")
+        x_na = self.batch.nonants(res.x)
+        xbar, xsqbar = compute_xbar(self.batch, x_na)
+        W = update_W(jnp.zeros_like(x_na), self.rho, x_na, xbar)
+        conv = convergence_metric(self.batch, x_na, xbar)
+        self.trivial_bound = float(self.Ebound(res.dual_obj))
+        self.best_bound = self.trivial_bound
+        self.state = PHState(
+            x=res.x, y=res.y, W=W, xbar=xbar, xsqbar=xsqbar,
+            obj=res.obj, dual_obj=res.dual_obj, conv=conv,
+            it=jnp.asarray(0, jnp.int32))
+        self.conv = float(conv)
+        global_toc(f"Iter0 trivial bound = {self.trivial_bound:.6g}, "
+                   f"conv = {float(conv):.6g}")
+        self._ext("post_iter0")
+        return self.trivial_bound
+
+    # -- one PH iteration, fully fused ------------------------------------
+    def _superstep_impl(self, state: PHState, rho, W_on, prox_on):
+        b = self.batch
+        c_eff, q_eff = ph_objective_arrays(
+            b, state.W, rho, state.xbar, W_on=W_on, prox_on=prox_on)
+        res = self.solver._solve_jit(
+            self.prep, c_eff, q_eff, b.lb, b.ub, b.obj_const,
+            state.x, state.y)
+        x_na = b.nonants(res.x)
+        xbar, xsqbar = compute_xbar(b, x_na)
+        W = update_W(state.W, rho, x_na, xbar)
+        conv = convergence_metric(b, x_na, xbar)
+        # report the TRUE objective at x (c, not c_eff)
+        obj = b.objective(res.x)
+        return PHState(
+            x=res.x, y=res.y, W=W, xbar=xbar, xsqbar=xsqbar,
+            obj=obj, dual_obj=res.dual_obj, conv=conv, it=state.it + 1)
+
+    def ph_iteration(self):
+        self.state = self._superstep(
+            self.state, self.rho, self.W_on, self.prox_on)
+        self.conv = float(self.state.conv)
+        return self.conv
+
+    # -- main loop (reference phbase.py:875-979 iterk_loop) ---------------
+    def iterk_loop(self):
+        max_iters = int(self.options.get("PHIterLimit", 100))
+        convthresh = float(self.options.get("convthresh", 1e-4))
+        verbose = self.options.get("verbose", False)
+        for k in range(1, max_iters + 1):
+            conv = self.ph_iteration()
+            self._ext("miditer")
+            if verbose or k % 10 == 0 or k == 1:
+                eobj = float(self.Eobjective(self.state.obj))
+                global_toc(f"PH iter {k:4d} conv={conv:.6e} "
+                           f"E[obj]={eobj:.6g}")
+            self._ext("enditer")
+            if self.spcomm is not None:
+                self.spcomm.sync()
+                if self.spcomm.is_converged():
+                    global_toc(f"PH terminated by hub at iter {k}")
+                    break
+            if conv < convthresh:
+                global_toc(f"PH converged (conv={conv:.3e} < "
+                           f"{convthresh}) at iter {k}")
+                break
+            self._ext("enditer_after_sync")
+        self._ext("post_everything")
+        return self.conv
+
+    def post_loops(self):
+        """Final expected objective (reference phbase.py:982)."""
+        eobj = float(self.Eobjective(self.state.obj))
+        if self.scenario_denouement is not None:
+            for i, name in enumerate(self.all_scenario_names):
+                self.scenario_denouement(0, name, self.state)
+        return eobj
+
+    # -- bounds -----------------------------------------------------------
+    def lagrangian_bound(self, W=None):
+        """Valid outer bound from the current W (reference:
+        cylinders/lagrangian_bounder.py — re-solve with W-only objective,
+        no prox, then Ebound).  Valid because the prob-weighted W sums to
+        zero per node by construction of update_W."""
+        b = self.batch
+        W = self.state.W if W is None else W
+        c_eff = b.c.at[:, b.nonant_idx].add(W)
+        res = self.solver.solve(
+            self.prep, c_eff, b.qdiag, b.lb, b.ub,
+            obj_const=b.obj_const, x0=self.state.x, y0=self.state.y)
+        return float(self.Ebound(res.dual_obj))
+
+    # -- spoke support ----------------------------------------------------
+    def root_xbar(self):
+        """Root-node consensus vector (K,) — candidate first-stage
+        solution, for xhat spokes and solution writers."""
+        return self.state.xbar[0]

@@ -1,0 +1,124 @@
+"""SPBase — scenario manager (reference: mpisppy/spbase.py, 651 LoC).
+
+Owns the lowered ScenarioBatch, its placement on the device mesh, and
+the bookkeeping the reference does rank-locally: probability
+normalization checks (spbase.py:457-502), nonant bookkeeping
+(spbase.py:293-330), solution gathering/writing (spbase.py:547-651).
+
+Scenario construction: either a fast vectorized `batch` is passed in
+directly, or the per-scenario `scenario_creator` contract is honored
+(reference spbase.py:255-273) and the results stacked.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import global_toc
+from .ir import ScenarioBatch, stack_scenarios
+from .parallel.mesh import ScenarioMesh
+
+
+class SPBase:
+    def __init__(
+        self,
+        options,
+        all_scenario_names,
+        scenario_creator=None,
+        scenario_denouement=None,
+        all_nodenames=None,
+        scenario_creator_kwargs=None,
+        variable_probability=None,
+        batch: ScenarioBatch | None = None,
+        mesh: ScenarioMesh | None = None,
+    ):
+        if variable_probability is not None:
+            raise NotImplementedError(
+                "variable_probability (per-variable probabilities, "
+                "reference spbase.py:394) is not supported yet; "
+                "failing loudly rather than computing wrong xbars")
+        self.options = dict(options or {})
+        self.all_scenario_names = list(all_scenario_names)
+        self.all_nodenames = all_nodenames  # multistage tree metadata
+        self.scenario_creator = scenario_creator
+        self.scenario_denouement = scenario_denouement
+        self.scenario_creator_kwargs = scenario_creator_kwargs or {}
+        self.mesh = mesh if mesh is not None else ScenarioMesh()
+
+        if batch is None:
+            if scenario_creator is None:
+                raise ValueError("need either a batch or a scenario_creator")
+            global_toc(f"Creating {len(self.all_scenario_names)} scenarios")
+            scens = [
+                scenario_creator(name, **self.scenario_creator_kwargs)
+                for name in self.all_scenario_names
+            ]
+            batch = stack_scenarios(scens, scen_names=self.all_scenario_names)
+        self.n_real_scens = len(self.all_scenario_names)
+        self.batch = self.mesh.shard_batch(batch)
+        self._verify_probabilities()
+        # sense: IR is always minimize (model.py negates for maximize);
+        # reference analog spbase.py:122 _set_sense
+        self.is_minimizing = True
+        global_toc(
+            f"SPBase: {self.n_real_scens} scenarios "
+            f"(padded to {self.batch.num_scens}) x "
+            f"{self.batch.num_vars} vars x {self.batch.num_rows} rows, "
+            f"{self.batch.num_nonants} nonants, "
+            f"{self.mesh.size} device(s)")
+
+    # -- integrity checks (reference spbase.py:150-175, :457-502) ---------
+    def _verify_probabilities(self):
+        tot = float(jnp.sum(self.batch.prob))
+        if abs(tot - 1.0) > 1e-6:
+            raise RuntimeError(
+                f"scenario probabilities sum to {tot}, not 1 "
+                "(reference hard-quits here too, spbase.py:470)")
+
+    # -- gathering / reporting (reference spbase.py:547-651) --------------
+    def gather_var_values_to_rank0(self, x=None):
+        """Return {(scen_name, var_name): value} for nonant variables.
+        Single-controller JAX: every host sees the global value; the MPI
+        gather disappears."""
+        if x is None:
+            raise ValueError("pass the (S, N) primal solution")
+        xn = np.asarray(self.batch.nonants(x))[: self.n_real_scens]
+        names = self.batch.tree.nonant_names
+        out = {}
+        for si, sname in enumerate(self.all_scenario_names):
+            for vi, vname in enumerate(names):
+                out[(sname, vname)] = float(xn[si, vi])
+        return out
+
+    def report_var_values_at_rank0(self, x, max_vars=20):
+        vals = self.gather_var_values_to_rank0(x)
+        for k, v in list(vals.items())[:max_vars]:
+            print(f"{k[0]:>12s} {k[1]:>28s} {v:12.4f}")
+
+    def write_first_stage_solution(self, path, xbar_root):
+        """CSV of root-node consensus values (reference spbase.py:618)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        names = self.batch.tree.nonant_names
+        arr = np.asarray(xbar_root)
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            for name, v in zip(names, arr.tolist()):
+                w.writerow([name, v])
+        global_toc(f"Wrote first-stage solution to {path}")
+
+    def write_tree_solution(self, directory, x):
+        """Per-scenario CSVs of all variables (reference spbase.py:633)."""
+        os.makedirs(directory, exist_ok=True)
+        xa = np.asarray(x)[: self.n_real_scens]
+        for si, sname in enumerate(self.all_scenario_names):
+            with open(os.path.join(directory, f"{sname}.csv"), "w",
+                      newline="") as f:
+                w = csv.writer(f)
+                for vi, vname in enumerate(self.batch.var_names
+                                           or range(xa.shape[1])):
+                    w.writerow([vname, float(xa[si, vi])])
+        global_toc(f"Wrote tree solution to {directory}/")

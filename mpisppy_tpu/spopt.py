@@ -1,0 +1,151 @@
+"""SPOpt — the solve engine (reference: mpisppy/spopt.py, 903 LoC).
+
+Where the reference's `solve_loop` walks local subproblems serially and
+crosses a process boundary into Gurobi per scenario (spopt.py:226, :85),
+here one call = one jitted batched PDHG solve over ALL scenarios at
+once.  Objective modifications (PH's W and prox, Lagrangian W-only,
+xhat fixing) arrive as array arguments — the nonant fix/restore caches
+of the reference (spopt.py:528-740) become pure functions of bounds
+arrays.
+
+Expectations (Eobjective spopt.py:310, Ebound :346) are probability-
+weighted sums over the sharded scenario axis; XLA inserts the psum.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import global_toc
+from .ops.pdhg import PDHGSolver, prepare_batch
+from .spbase import SPBase
+
+
+class SPOpt(SPBase):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        o = self.options
+        self.solver = PDHGSolver(
+            max_iters=int(o.get("pdhg_max_iters", 20000)),
+            eps=float(o.get("pdhg_eps", 1e-6)),
+            check_every=int(o.get("pdhg_check_every", 40)),
+            restart_every=int(o.get("pdhg_restart_every", 4)),
+        )
+        global_toc("Preparing batch (Ruiz scaling + ||A|| estimate)")
+        self.prep = prepare_batch(
+            self.batch.A, self.batch.row_lo, self.batch.row_hi)
+        # warm-start caches (analog of persistent-solver state,
+        # reference spopt.py:877 set_instance_retry — license logic gone)
+        self._x_warm = None
+        self._y_warm = None
+        self._solve_times = []
+
+    # -- hot path ---------------------------------------------------------
+    def solve_loop(self, c=None, qdiag=None, lb=None, ub=None,
+                   warm=True, dtiming=False):
+        """Solve every scenario subproblem (batched).  Any of
+        c/qdiag/lb/ub override the batch's own arrays (this is how PH,
+        Lagrangian and xhat objectives/fixings are expressed).
+
+        Returns the ops.pdhg.SolveResult.
+        """
+        b = self.batch
+        t0 = time.time()
+        res = self.solver.solve(
+            self.prep,
+            b.c if c is None else c,
+            b.qdiag if qdiag is None else qdiag,
+            b.lb if lb is None else lb,
+            b.ub if ub is None else ub,
+            obj_const=b.obj_const,
+            x0=self._x_warm if warm else None,
+            y0=self._y_warm if warm else None,
+        )
+        if warm:
+            self._x_warm = res.x
+            self._y_warm = res.y
+        if dtiming or self.options.get("display_timing"):
+            jax.block_until_ready(res.x)
+            dt = time.time() - t0
+            self._solve_times.append(dt)
+            global_toc(f"solve_loop: {dt*1e3:8.1f} ms, "
+                       f"iters={int(res.iters)}, "
+                       f"conv={int(np.sum(np.asarray(res.converged)))}"
+                       f"/{b.num_scens}")
+        return res
+
+    def clear_warmstart(self):
+        self._x_warm = None
+        self._y_warm = None
+
+    # -- expectations (Allreduce analogs) ---------------------------------
+    def Eobjective(self, objs):
+        """E[objective] over scenarios (reference spopt.py:310).  `objs`
+        is the per-scenario (S,) objective; padding scenarios carry
+        probability 0 so they vanish."""
+        return jnp.sum(self.batch.prob * objs)
+
+    def Ebound(self, dual_objs):
+        """Valid expected outer bound from per-scenario dual objectives
+        (reference spopt.py:346 uses solver bounds)."""
+        return jnp.sum(self.batch.prob * dual_objs)
+
+    def feas_prob(self, res, tol=None):
+        """Probability mass of scenarios whose solve is feasible/
+        converged (reference spopt.py:411 feas_prob; :175-194
+        classifies solver status).  First-order analog: primal residual
+        under tolerance."""
+        tol = tol or 10 * self.solver.eps
+        ok = res.pres < tol
+        return float(jnp.sum(jnp.where(ok, self.batch.prob, 0.0)))
+
+    def infeas_prob(self, res, tol=None):
+        return 1.0 - self.feas_prob(res, tol)
+
+    def avg_min_max(self, vals):
+        """Prob>0-masked avg/min/max of a per-scenario quantity
+        (reference spopt.py:469)."""
+        mask = self.batch.prob > 0
+        v = np.asarray(vals)
+        vm = v[np.asarray(mask)]
+        return float(np.mean(vm)), float(np.min(vm)), float(np.max(vm))
+
+    def evaluate_xhat(self, nonant_values, upto_stage=None, tol=None):
+        """Expected objective with nonants fixed to a candidate — the
+        implementable inner bound (reference utils/xhat_eval.py:293).
+        Returns (Eobj, feasible)."""
+        lb, ub = self.fixed_nonant_bounds(nonant_values,
+                                          upto_stage=upto_stage)
+        res = self.solve_loop(lb=lb, ub=ub, warm=False)
+        feas = self.feas_prob(res, tol=tol) > 1.0 - 1e-6
+        return float(self.Eobjective(res.obj)), feas
+
+    # -- nonant fixing (reference spopt.py:592-740 _fix_nonants) ----------
+    def fixed_nonant_bounds(self, values, upto_stage=None):
+        """Bounds arrays that pin nonant slots to `values`.
+
+        values: (K,) to pin all scenarios alike, or (S, K) per-scenario
+        (multistage candidate trees).  upto_stage: only fix slots whose
+        stage <= upto_stage (reference xhat_eval.py:326
+        fix_nonants_upto_stage).
+        Returns (lb, ub).
+        """
+        b = self.batch
+        vals = jnp.asarray(values)
+        if vals.ndim == 1:
+            vals = jnp.broadcast_to(vals[None, :],
+                                    (b.num_scens, b.num_nonants))
+        lb = b.lb.at[:, b.nonant_idx].set(vals)
+        ub = b.ub.at[:, b.nonant_idx].set(vals)
+        if upto_stage is not None:
+            stage = jnp.asarray(b.tree.stage_of, jnp.int32)
+            keep = stage <= upto_stage
+            lb = lb.at[:, b.nonant_idx].set(
+                jnp.where(keep[None, :], vals, b.lb[:, b.nonant_idx]))
+            ub = ub.at[:, b.nonant_idx].set(
+                jnp.where(keep[None, :], vals, b.ub[:, b.nonant_idx]))
+        return lb, ub

@@ -1,0 +1,23 @@
+"""Host-platform helpers.
+
+The TPU plugin ("axon") may be pre-registered by the environment's
+sitecustomize; once registered, even JAX_PLATFORMS=cpu initializes its
+device tunnel, which hangs when the tunnel is down.  Every CPU-only
+entry point (tests, dryrun, bench smoke) must call
+`ensure_cpu_backend()` BEFORE the first jax backend initialization.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_cpu_backend(force=False):
+    """Deregister the TPU plugin and pin jax to CPU.  No-op unless
+    JAX_PLATFORMS requests cpu (or force=True)."""
+    if not force and "cpu" not in os.environ.get("JAX_PLATFORMS", ""):
+        return
+    import jax._src.xla_bridge as xb
+    xb._backend_factories.pop("axon", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")

