@@ -65,6 +65,27 @@ _register(PreparedBatch,
 
 
 @dataclasses.dataclass(frozen=True)
+class ConsensusSpec:
+    """Nonanticipativity structure for EXACT extensive-form solves.
+
+    With a spec, the solver treats each (tree node, nonant slot) as ONE
+    shared variable broadcast to its member scenarios: the primal
+    gradient is segment-summed over node members before the update (the
+    adjoint of the broadcast), so the batched iteration solves the
+    monolithic EF — the TPU-native analog of the reference's
+    `_create_EF_from_scen_dict` nonant equality constraints
+    (reference sputils.py:308-336) without ever materializing the big
+    matrix.  Requires prepare_batch(shared_cols=True).
+    """
+    node_of: Any      # (S, K) node id per scenario per nonant slot
+    nonant_idx: Any   # (K,) column indices of nonant slots
+    num_nodes: int = 1
+
+
+_register(ConsensusSpec, ("node_of", "nonant_idx"), ("num_nodes",))
+
+
+@dataclasses.dataclass(frozen=True)
 class SolveResult:
     x: Any          # (S, N) primal solution (unscaled)
     y: Any          # (S, M) row duals (unscaled)
@@ -126,10 +147,36 @@ def _power_iteration(A, iters=40, seed=0):
     return jnp.linalg.norm(av, axis=1)
 
 
-@partial(jax.jit, static_argnames=("ruiz_iters",))
-def prepare_batch(A, row_lo, row_hi, ruiz_iters=10):
+def _ruiz_shared(A, n_iter=10, eps=1e-12):
+    """Ruiz with a SINGLE column scaling shared by all scenarios (the
+    EF matrix's column space) — required by consensus solves, where a
+    shared variable must see one consistent scaling."""
+    S, M, N = A.shape
+    d_row = jnp.ones((S, M), A.dtype)
+    d_col = jnp.ones((N,), A.dtype)
+
+    def body(_, carry):
+        As, dr, dc = carry
+        rmax = jnp.max(jnp.abs(As), axis=2)           # (S, M)
+        cmax = jnp.max(jnp.abs(As), axis=(0, 1))      # (N,)
+        sr = jnp.where(rmax <= eps, 1.0,
+                       1.0 / jnp.sqrt(jnp.maximum(rmax, eps)))
+        sc = jnp.where(cmax <= eps, 1.0,
+                       1.0 / jnp.sqrt(jnp.maximum(cmax, eps)))
+        As = As * sr[:, :, None] * sc[None, None, :]
+        return As, dr * sr, dc * sc
+
+    A, d_row, d_col = lax.fori_loop(0, n_iter, body, (A, d_row, d_col))
+    return A, d_row, jnp.broadcast_to(d_col[None, :], (S, N))
+
+
+@partial(jax.jit, static_argnames=("ruiz_iters", "shared_cols"))
+def prepare_batch(A, row_lo, row_hi, ruiz_iters=10, shared_cols=False):
     """One-time per-batch preprocessing (scale + norm estimate)."""
-    As, d_row, d_col = _ruiz(A, n_iter=ruiz_iters)
+    if shared_cols:
+        As, d_row, d_col = _ruiz_shared(A, n_iter=ruiz_iters)
+    else:
+        As, d_row, d_col = _ruiz(A, n_iter=ruiz_iters)
     anorm = _power_iteration(As)
     return PreparedBatch(
         A=As,
@@ -160,12 +207,16 @@ def _dual_prox(v, sigma, lo, hi):
     return v - sigma[..., None] * zc
 
 
-def _residuals(x, y, c, qdiag, A, row_lo, row_hi, lb, ub):
+def _residuals(x, y, c, qdiag, A, row_lo, row_hi, lb, ub, cavg=None):
     """KKT residuals + gap, all relative, inf-norms. Batched.
 
     Follows the PDLP convention: reduced-cost terms whose matching bound
     is infinite are projected out of the dual objective and charged to
     the dual residual instead.
+
+    cavg: optional consensus averaging fn — replaces each nonant slot's
+    reduced cost by (segment sum / member count) so per-scenario sums of
+    rc terms equal the shared-variable (EF) dual-objective terms.
     """
     Ax = jnp.einsum("smn,sn->sm", A, x)
     # primal violation of row bounds (box is enforced by projection)
@@ -180,6 +231,8 @@ def _residuals(x, y, c, qdiag, A, row_lo, row_hi, lb, ub):
     grad = c + qdiag * x
     aty = jnp.einsum("smn,sm->sn", A, y)
     r = grad + aty
+    if cavg is not None:
+        r = cavg(r)
     # split reduced cost by sign; valid part pairs with a finite bound
     rpos = jnp.maximum(r, 0.0)
     rneg = jnp.minimum(r, 0.0)
@@ -252,9 +305,12 @@ class PDHGSolver:
 
     # -- public ----------------------------------------------------------
     def solve(self, prep: PreparedBatch, c, qdiag, lb, ub,
-              obj_const=None, x0=None, y0=None) -> SolveResult:
+              obj_const=None, x0=None, y0=None,
+              consensus: ConsensusSpec | None = None) -> SolveResult:
         """Solve the batch.  c/qdiag/lb/ub are UNSCALED user-space arrays
-        (S, N); x0/y0 optional warm starts in user space."""
+        (S, N); x0/y0 optional warm starts in user space.  With a
+        ConsensusSpec, solves the monolithic EF (prep must come from
+        prepare_batch(shared_cols=True))."""
         S, N = c.shape
         M = prep.A.shape[1]
         if obj_const is None:
@@ -263,10 +319,12 @@ class PDHGSolver:
             x0 = jnp.zeros((S, N), c.dtype)
         if y0 is None:
             y0 = jnp.zeros((S, M), c.dtype)
-        return self._solve_jit(prep, c, qdiag, lb, ub, obj_const, x0, y0)
+        return self._solve_jit(prep, c, qdiag, lb, ub, obj_const, x0, y0,
+                               consensus)
 
     # -- impl --------------------------------------------------------
-    def _solve_impl(self, prep, c, qdiag, lb, ub, obj_const, x0, y0):
+    def _solve_impl(self, prep, c, qdiag, lb, ub, obj_const, x0, y0,
+                    consensus=None):
         dc, dr = prep.d_col, prep.d_row
         # scale into solver space
         cs = c * dc
@@ -277,12 +335,58 @@ class PDHGSolver:
                        lbs, ubs)
         ys0 = y0 / dr
         A, rlo, rhi = prep.A, prep.row_lo, prep.row_hi
-        anorm = prep.anorm
-        qmax = jnp.max(qs, axis=1)
+        S, N = cs.shape
         # clamp the tolerance to what the dtype can express: in float32
         # an eps below ~1e-5 can never be met and every solve would spin
         # to max_iters
         eps = max(self.eps, 100.0 * float(jnp.finfo(cs.dtype).eps))
+
+        if consensus is not None:
+            na = consensus.nonant_idx
+            K = na.shape[0]
+            nn = consensus.num_nodes
+            cols = jnp.broadcast_to(jnp.arange(K)[None, :],
+                                    consensus.node_of.shape)
+            flatid = consensus.node_of * K + cols          # (S, K)
+            fl = flatid.reshape(-1)
+            counts = jnp.zeros((nn * K,), cs.dtype).at[fl].add(1.0)[flatid]
+
+            def csum(g):
+                """Adjoint of the shared-variable broadcast: nonant
+                slots <- sum over node members, broadcast back."""
+                z = jnp.zeros((nn * K,), g.dtype).at[fl].add(
+                    g[:, na].reshape(-1))
+                return g.at[:, na].set(z[flatid])
+
+            def cavg(g):
+                g2 = csum(g)
+                return g2.at[:, na].set(g2[:, na] / counts)
+
+            # z-space norm weights: shared coords counted once
+            wz = jnp.ones_like(cs).at[:, na].set(1.0 / counts)
+
+            def znorm(g):
+                return jnp.sqrt(jnp.sum(wz * g * g)) + 1e-30
+
+            # power iteration for the EF operator  M = blockdiag(A) . B
+            key = jax.random.PRNGKey(0)
+            v = cavg(jax.random.normal(key, (S, N), cs.dtype))
+
+            def pbody(_, v):
+                v = v / znorm(v)
+                u = jnp.einsum("smn,sn->sm", A, v)
+                return csum(jnp.einsum("smn,sm->sn", A, u))
+
+            v = lax.fori_loop(0, 40, pbody, v)
+            anorm_c = jnp.sqrt(jnp.sum(
+                jnp.einsum("smn,sn->sm", A, v / znorm(v)) ** 2))
+            anorm = jnp.full((S,), jnp.maximum(anorm_c, 1.0), cs.dtype)
+            qmax = jnp.full((S,), jnp.max(csum(qs)), cs.dtype)
+            xs0 = jnp.clip(cavg(xs0), lbs, ubs)  # consistent warm start
+        else:
+            csum = cavg = None
+            anorm = prep.anorm
+            qmax = jnp.max(qs, axis=1)
 
         def steps(x, y, omega, n):
             """n PDHG iterations; returns final + running sums."""
@@ -292,6 +396,8 @@ class PDHGSolver:
             def body(_, carry):
                 x, y, xs, ys = carry
                 grad = cs + qs * x + jnp.einsum("smn,sm->sn", A, y)
+                if csum is not None:
+                    grad = csum(grad)
                 xn = _proj_box(x - tau[:, None] * grad, lbs, ubs)
                 xt = 2.0 * xn - x
                 v = y + sigma[:, None] * jnp.einsum("smn,sn->sm", A, xt)
@@ -304,8 +410,18 @@ class PDHGSolver:
             return x, y, xs, ys
 
         def kkt_score(x, y):
-            pres, dres, gap, _, _ = _residuals(
-                x, y, cs, qs, A, rlo, rhi, lbs, ubs)
+            pres, dres, gap, pobj, dobj = _residuals(
+                x, y, cs, qs, A, rlo, rhi, lbs, ubs, cavg=cavg)
+            if consensus is not None:
+                # EF is one problem: all scenarios share one verdict,
+                # and only the SUMS of the per-scenario objective pieces
+                # are meaningful for the duality gap
+                pres = jnp.broadcast_to(jnp.max(pres), pres.shape)
+                dres = jnp.broadcast_to(jnp.max(dres), dres.shape)
+                ps, ds = jnp.sum(pobj), jnp.sum(dobj)
+                gap = jnp.broadcast_to(
+                    jnp.abs(ps - ds) / (1.0 + jnp.abs(ps) + jnp.abs(ds)),
+                    gap.shape)
             return pres + dres + gap, pres, dres, gap
 
         ne = self.check_every
@@ -338,8 +454,17 @@ class PDHGSolver:
                 xr = jnp.where(take_avg[:, None], xa, x)
                 yr = jnp.where(take_avg[:, None], ya, y)
                 # primal weight update (PDLP eq. (10)-style smoothing)
-                dx = jnp.linalg.norm(xr - carry.x_last, axis=1)
-                dy = jnp.linalg.norm(yr - carry.y_last, axis=1)
+                if consensus is not None:
+                    # one shared problem -> one shared omega (per-scenario
+                    # omegas would give inconsistent step sizes and break
+                    # the shared-variable invariant)
+                    dx = jnp.broadcast_to(
+                        jnp.linalg.norm(xr - carry.x_last), (S,))
+                    dy = jnp.broadcast_to(
+                        jnp.linalg.norm(yr - carry.y_last), (S,))
+                else:
+                    dx = jnp.linalg.norm(xr - carry.x_last, axis=1)
+                    dy = jnp.linalg.norm(yr - carry.y_last, axis=1)
                 ok = (dx > 1e-12) & (dy > 1e-12)
                 ratio = jnp.where(ok, dy / jnp.maximum(dx, 1e-12), 1.0)
                 omega = jnp.where(
@@ -382,8 +507,7 @@ class PDHGSolver:
 
         x = jnp.where(fin.converged[:, None], fin.x_best, fin.x)
         y = jnp.where(fin.converged[:, None], fin.y_best, fin.y)
-        pres, dres, gap, _, _ = _residuals(
-            x, y, cs, qs, A, rlo, rhi, lbs, ubs)
+        _, pres, dres, gap = kkt_score(x, y)
         # unscale
         xu = x * dc
         yu = y * dr
@@ -397,7 +521,7 @@ class PDHGSolver:
                       prep.row_lo),
             jnp.where(jnp.isfinite(prep.row_hi), prep.row_hi / dr,
                       prep.row_hi),
-            lb, ub)
+            lb, ub, cavg=cavg)
         return SolveResult(
             x=xu, y=yu, obj=pobj, dual_obj=dobj + obj_const,
             pres=pres, dres=dres, gap=gap,

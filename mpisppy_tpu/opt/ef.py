@@ -1,0 +1,87 @@
+"""ExtensiveForm — monolithic EF solve (reference: mpisppy/opt/ef.py).
+
+The reference builds one big Pyomo model: scenario sub-blocks, a
+probability-weighted summed objective, and explicit nonanticipativity
+equality constraints against first-seen reference variables
+(reference sputils.py:209-341 _create_EF_from_scen_dict), then makes a
+single monolithic solver call (opt/ef.py:66 solve_extensive_form) —
+2939 s of Gurobi barrier at farmer-1000 scale (BASELINE.md).
+
+Here the EF is never materialized: the batched PDHG kernel runs in
+consensus mode (ops/pdhg.ConsensusSpec) where each (node, nonant-slot)
+is one shared variable — the per-scenario matvecs stay batched on the
+MXU and the consensus coupling is a segment-sum per iteration.  The
+probability weighting moves into the per-scenario objective arrays.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import global_toc
+from ..ops.pdhg import ConsensusSpec, prepare_batch
+from ..spopt import SPOpt
+
+
+class ExtensiveForm(SPOpt):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        b = self.batch
+        # re-prepare with SHARED column scaling (consensus requirement)
+        self.prep = prepare_batch(b.A, b.row_lo, b.row_hi,
+                                  shared_cols=True)
+        self.consensus = ConsensusSpec(
+            node_of=b.tree.node_of,
+            nonant_idx=b.nonant_idx,
+            num_nodes=b.tree.num_nodes)
+        self._result = None
+
+    def solve_extensive_form(self, solver_options=None, tee=False):
+        """One batched consensus solve == the reference's single
+        monolithic solver call (opt/ef.py:66)."""
+        b = self.batch
+        p = b.prob[:, None]
+        res = self.solver.solve(
+            self.prep,
+            b.c * p,
+            b.qdiag * p,
+            b.lb, b.ub,
+            obj_const=b.obj_const * b.prob,
+            consensus=self.consensus)
+        self._result = res
+        global_toc(
+            f"EF solve: obj={self.get_objective_value():.6g} "
+            f"pres={float(jnp.max(res.pres)):.2e} "
+            f"gap={float(jnp.max(res.gap)):.2e} "
+            f"iters={int(res.iters)}", tee or True)
+        return res
+
+    @property
+    def solved(self):
+        return self._result is not None
+
+    def get_objective_value(self):
+        """EF objective = sum of probability-weighted scenario pieces
+        (reference opt/ef.py:97)."""
+        if self._result is None:
+            raise RuntimeError("call solve_extensive_form first")
+        return float(jnp.sum(self._result.obj))
+
+    def get_dual_bound(self):
+        """Valid lower bound from the EF dual estimate."""
+        if self._result is None:
+            raise RuntimeError("call solve_extensive_form first")
+        return float(jnp.sum(self._result.dual_obj))
+
+    def get_root_solution(self):
+        """Root-node nonant values (K,) (reference opt/ef.py:114)."""
+        if self._result is None:
+            raise RuntimeError("call solve_extensive_form first")
+        x_na = self.batch.nonants(self._result.x)
+        # all scenarios agree by construction; read scenario 0
+        return np.asarray(x_na[0])
+
+    def nonants(self):
+        """Per-scenario nonant values (reference sputils.ef_nonants)."""
+        return np.asarray(self.batch.nonants(self._result.x))
