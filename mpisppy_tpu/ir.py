@@ -144,6 +144,33 @@ _register(
 )
 
 
+def node_segment_sum(node_of, num_nodes):
+    """Per-(tree node, nonant slot) segment reduction.
+
+    This is THE consensus primitive: the (node, slot) pair is one
+    segment key (flatid = node_of * K + slot), and a reduction over a
+    (S, K) array scatter-adds into the nn*K segments then gathers back
+    to scenario layout.  Used by both PH's xbar averaging
+    (phbase.compute_xbar — the analog of the reference's per-tree-node
+    Allreduce, phbase.py:27-107) and the EF consensus solver's
+    shared-variable adjoint (ops/pdhg.ConsensusSpec).
+
+    Returns (flatid (S, K) int32, segsum) where segsum(v: (S, K))
+    -> (S, K) holds each element's segment total.
+    """
+    K = node_of.shape[1]
+    cols = jnp.broadcast_to(jnp.arange(K)[None, :], node_of.shape)
+    flatid = node_of * K + cols
+    fl = flatid.reshape(-1)
+    size = num_nodes * K
+
+    def segsum(v):
+        z = jnp.zeros((size,), v.dtype).at[fl].add(v.reshape(-1))
+        return z[flatid]
+
+    return flatid, segsum
+
+
 def stack_scenarios(scens, scen_names=None):
     """Stack a list of single-scenario dicts/batches (S=1 each) into one
     ScenarioBatch.  Mirrors SPBase._create_scenarios looping the user's
@@ -222,10 +249,15 @@ def pad_scenarios(batch: ScenarioBatch, to: int) -> ScenarioBatch:
         return jnp.concatenate([v, jnp.full(pad_shape, fill, v.dtype)], axis=0)
 
     tree = batch.tree
+    # pads get their own dummy tree node: probability-0 keeps them out
+    # of every xbar average, and a distinct node id keeps them out of
+    # EF consensus groups (where membership is structural, not
+    # probability-weighted — a pad in ROOT would drag its tiny [0,1]
+    # pad box into the shared first-stage variable)
     new_tree = TreeInfo(
-        node_of=padfield(tree.node_of, 0),
+        node_of=padfield(tree.node_of, tree.num_nodes),
         prob=padfield(tree.prob, 0.0),
-        num_nodes=tree.num_nodes,
+        num_nodes=tree.num_nodes + 1,
         stage_of=tree.stage_of,
         nonant_names=tree.nonant_names,
         scen_names=tree.scen_names + tuple(

@@ -107,25 +107,32 @@ _register(SolveResult,
 # scaling
 # --------------------------------------------------------------------------
 
-def _ruiz(A, n_iter=10, eps=1e-12):
+def _ruiz(A, n_iter=10, eps=1e-12, shared_cols=False):
     """Ruiz equilibration: returns (A_scaled, d_row, d_col) with
-    A_scaled = diag(d_row) @ A @ diag(d_col), rows/cols ~unit inf-norm."""
+    A_scaled = diag(d_row) @ A @ diag(d_col), rows/cols ~unit inf-norm.
+
+    shared_cols: use ONE column scaling across all scenarios (the EF
+    matrix's column space) — required by consensus solves, where a
+    shared variable must see one consistent scaling."""
     S, M, N = A.shape
     d_row = jnp.ones((S, M), A.dtype)
-    d_col = jnp.ones((S, N), A.dtype)
+    d_col = jnp.ones((N,) if shared_cols else (S, N), A.dtype)
 
     def body(_, carry):
         As, dr, dc = carry
         rmax = jnp.max(jnp.abs(As), axis=2)            # (S, M)
-        cmax = jnp.max(jnp.abs(As), axis=1)            # (S, N)
-        sr = 1.0 / jnp.sqrt(jnp.maximum(rmax, eps))
-        sc = 1.0 / jnp.sqrt(jnp.maximum(cmax, eps))
-        sr = jnp.where(rmax <= eps, 1.0, sr)
-        sc = jnp.where(cmax <= eps, 1.0, sc)
-        As = As * sr[:, :, None] * sc[:, None, :]
+        cmax = jnp.max(jnp.abs(As), axis=(0, 1) if shared_cols else 1)
+        sr = jnp.where(rmax <= eps, 1.0,
+                       1.0 / jnp.sqrt(jnp.maximum(rmax, eps)))
+        sc = jnp.where(cmax <= eps, 1.0,
+                       1.0 / jnp.sqrt(jnp.maximum(cmax, eps)))
+        sc_b = sc[None, None, :] if shared_cols else sc[:, None, :]
+        As = As * sr[:, :, None] * sc_b
         return As, dr * sr, dc * sc
 
     A, d_row, d_col = lax.fori_loop(0, n_iter, body, (A, d_row, d_col))
+    if shared_cols:
+        d_col = jnp.broadcast_to(d_col[None, :], (S, N))
     return A, d_row, d_col
 
 
@@ -147,36 +154,10 @@ def _power_iteration(A, iters=40, seed=0):
     return jnp.linalg.norm(av, axis=1)
 
 
-def _ruiz_shared(A, n_iter=10, eps=1e-12):
-    """Ruiz with a SINGLE column scaling shared by all scenarios (the
-    EF matrix's column space) — required by consensus solves, where a
-    shared variable must see one consistent scaling."""
-    S, M, N = A.shape
-    d_row = jnp.ones((S, M), A.dtype)
-    d_col = jnp.ones((N,), A.dtype)
-
-    def body(_, carry):
-        As, dr, dc = carry
-        rmax = jnp.max(jnp.abs(As), axis=2)           # (S, M)
-        cmax = jnp.max(jnp.abs(As), axis=(0, 1))      # (N,)
-        sr = jnp.where(rmax <= eps, 1.0,
-                       1.0 / jnp.sqrt(jnp.maximum(rmax, eps)))
-        sc = jnp.where(cmax <= eps, 1.0,
-                       1.0 / jnp.sqrt(jnp.maximum(cmax, eps)))
-        As = As * sr[:, :, None] * sc[None, None, :]
-        return As, dr * sr, dc * sc
-
-    A, d_row, d_col = lax.fori_loop(0, n_iter, body, (A, d_row, d_col))
-    return A, d_row, jnp.broadcast_to(d_col[None, :], (S, N))
-
-
 @partial(jax.jit, static_argnames=("ruiz_iters", "shared_cols"))
 def prepare_batch(A, row_lo, row_hi, ruiz_iters=10, shared_cols=False):
     """One-time per-batch preprocessing (scale + norm estimate)."""
-    if shared_cols:
-        As, d_row, d_col = _ruiz_shared(A, n_iter=ruiz_iters)
-    else:
-        As, d_row, d_col = _ruiz(A, n_iter=ruiz_iters)
+    As, d_row, d_col = _ruiz(A, n_iter=ruiz_iters, shared_cols=shared_cols)
     anorm = _power_iteration(As)
     return PreparedBatch(
         A=As,
@@ -342,21 +323,16 @@ class PDHGSolver:
         eps = max(self.eps, 100.0 * float(jnp.finfo(cs.dtype).eps))
 
         if consensus is not None:
+            from ..ir import node_segment_sum
             na = consensus.nonant_idx
-            K = na.shape[0]
-            nn = consensus.num_nodes
-            cols = jnp.broadcast_to(jnp.arange(K)[None, :],
-                                    consensus.node_of.shape)
-            flatid = consensus.node_of * K + cols          # (S, K)
-            fl = flatid.reshape(-1)
-            counts = jnp.zeros((nn * K,), cs.dtype).at[fl].add(1.0)[flatid]
+            _, segsum = node_segment_sum(consensus.node_of,
+                                         consensus.num_nodes)
+            counts = segsum(jnp.ones_like(cs[:, na]))
 
             def csum(g):
                 """Adjoint of the shared-variable broadcast: nonant
                 slots <- sum over node members, broadcast back."""
-                z = jnp.zeros((nn * K,), g.dtype).at[fl].add(
-                    g[:, na].reshape(-1))
-                return g.at[:, na].set(z[flatid])
+                return g.at[:, na].set(segsum(g[:, na]))
 
             def cavg(g):
                 g2 = csum(g)

@@ -25,12 +25,13 @@ from ..spopt import SPOpt
 
 
 class ExtensiveForm(SPOpt):
+    # consensus solves need one column scaling shared by all scenarios;
+    # SPOpt.__init__ reads this so the batch is prepared exactly once
+    _shared_cols = True
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         b = self.batch
-        # re-prepare with SHARED column scaling (consensus requirement)
-        self.prep = prepare_batch(b.A, b.row_lo, b.row_hi,
-                                  shared_cols=True)
         self.consensus = ConsensusSpec(
             node_of=b.tree.node_of,
             nonant_idx=b.nonant_idx,
@@ -54,7 +55,7 @@ class ExtensiveForm(SPOpt):
             f"EF solve: obj={self.get_objective_value():.6g} "
             f"pres={float(jnp.max(res.pres)):.2e} "
             f"gap={float(jnp.max(res.gap)):.2e} "
-            f"iters={int(res.iters)}", tee or True)
+            f"iters={int(res.iters)}", tee)
         return res
 
     @property
@@ -83,5 +84,9 @@ class ExtensiveForm(SPOpt):
         return np.asarray(x_na[0])
 
     def nonants(self):
-        """Per-scenario nonant values (reference sputils.ef_nonants)."""
-        return np.asarray(self.batch.nonants(self._result.x))
+        """Per-scenario nonant values for the REAL scenarios, padding
+        excluded (reference sputils.ef_nonants)."""
+        if self._result is None:
+            raise RuntimeError("call solve_extensive_form first")
+        return np.asarray(
+            self.batch.nonants(self._result.x))[: self.n_real_scens]

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import global_toc
-from .ir import ScenarioBatch
+from .ir import ScenarioBatch, node_segment_sum
 from .spopt import SPOpt
 
 
@@ -66,25 +66,12 @@ def compute_xbar(batch: ScenarioBatch, x_na, extra=None):
     gathered back to scenario-slot layout.
     """
     tree = batch.tree
-    node_of = tree.node_of                       # (S, K)
     p = tree.prob[:, None]                       # (S, 1)
-    K = x_na.shape[1]
-    nn = tree.num_nodes
-    cols = jnp.broadcast_to(jnp.arange(K)[None, :], node_of.shape)
-    flatid = node_of * K + cols                  # (S, K) segment keys
-
-    def nodesum(v):
-        z = jnp.zeros((nn * K,), v.dtype)
-        return z.at[flatid.reshape(-1)].add(v.reshape(-1))
-
-    wsum = nodesum(jnp.broadcast_to(p, x_na.shape))
-    xsum = nodesum(p * x_na)
-    xsqsum = nodesum(p * x_na * x_na)
+    _, segsum = node_segment_sum(tree.node_of, tree.num_nodes)
+    wsum = segsum(jnp.broadcast_to(p, x_na.shape))
     denom = jnp.maximum(wsum, 1e-30)
-    xbar_nodes = xsum / denom
-    xsqbar_nodes = xsqsum / denom
-    xbar = xbar_nodes[flatid]
-    xsqbar = xsqbar_nodes[flatid]
+    xbar = segsum(p * x_na) / denom
+    xsqbar = segsum(p * x_na * x_na) / denom
     return xbar, xsqbar
 
 

@@ -26,6 +26,10 @@ from .spbase import SPBase
 
 
 class SPOpt(SPBase):
+    # subclasses needing one column scaling shared across scenarios
+    # (consensus/EF solves) set this so the batch is prepared once
+    _shared_cols = False
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         o = self.options
@@ -37,7 +41,8 @@ class SPOpt(SPBase):
         )
         global_toc("Preparing batch (Ruiz scaling + ||A|| estimate)")
         self.prep = prepare_batch(
-            self.batch.A, self.batch.row_lo, self.batch.row_hi)
+            self.batch.A, self.batch.row_lo, self.batch.row_hi,
+            shared_cols=self._shared_cols)
         # warm-start caches (analog of persistent-solver state,
         # reference spopt.py:877 set_instance_retry — license logic gone)
         self._x_warm = None

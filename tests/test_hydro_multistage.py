@@ -1,0 +1,112 @@
+"""Multistage tests: tree utilities + hydro golden values.
+
+Reference analog: mpisppy/tests/test_ef_ph.py Test_hydro (3-stage,
+branching factors [3,3]): PH trivial bound == 180 and consensus
+E[objective] == 190 at 2 significant figures.
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.scenario_tree import (
+    MultistageTree, create_nodenames_from_branching_factors)
+from mpisppy_tpu.models import hydro
+
+
+def round_pos_sig(x, sig=2):
+    """Reference tests/utils.py round_pos_sig."""
+    return round(x, -int(np.floor(np.log10(abs(x)))) + (sig - 1))
+
+
+class TestTree:
+    def test_nodenames(self):
+        names = create_nodenames_from_branching_factors([3, 3])
+        assert names == ["ROOT", "ROOT_0", "ROOT_1", "ROOT_2"]
+        names = create_nodenames_from_branching_factors([2, 2, 2])
+        assert names == ["ROOT", "ROOT_0", "ROOT_1",
+                         "ROOT_0_0", "ROOT_0_1", "ROOT_1_0", "ROOT_1_1"]
+
+    def test_scen_paths(self):
+        t = MultistageTree([3, 3])
+        assert t.num_scens == 9
+        assert t.num_nodes == 4
+        assert t.nodes_for_scen(0) == [0, 1]
+        assert t.nodes_for_scen(4) == [0, 2]
+        assert t.nodes_for_scen(8) == [0, 3]
+        assert t.nodenames_for_scen(6) == ["ROOT", "ROOT_2"]
+        assert abs(t.scen_probability(5) - 1 / 9) < 1e-12
+
+    def test_three_level(self):
+        t = MultistageTree([2, 2, 2])
+        assert t.num_scens == 8
+        assert t.num_nodes == 7
+        # scenario 5 = digits (1, 0, 1): ROOT -> ROOT_1 -> ROOT_1_0
+        assert t.nodes_for_scen(5) == [0, 2, 5]
+        assert t.parent_of(5) == 2
+        assert t.parent_of(2) == 0
+        assert t.parent_of(0) is None
+        assert t.stage_of_node(0) == 1
+        assert t.stage_of_node(2) == 2
+        assert t.stage_of_node(5) == 3
+
+    def test_node_of_slots(self):
+        t = MultistageTree([3, 3])
+        node_of = t.node_of_slots(7, (1, 1, 2, 2))
+        assert node_of.tolist() == [0, 0, 3, 3]
+
+
+class TestHydro:
+    def test_batch_shapes(self):
+        b = hydro.build_batch()
+        assert b.num_scens == 9
+        assert b.num_vars == 13
+        assert b.num_nonants == 8
+        assert b.tree.num_nodes == 4
+        assert float(np.sum(np.asarray(b.prob))) == pytest.approx(1.0)
+
+    def test_creator_matches_batch(self):
+        """LinearModel creator path agrees with the vectorized builder."""
+        b = hydro.build_batch()
+        s4 = hydro.scenario_creator("Scen5", branching_factors=[3, 3])
+        np.testing.assert_allclose(np.asarray(s4.c[0]),
+                                   np.asarray(b.c[4]), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(s4.row_hi[0]),
+                                   np.asarray(b.row_hi[4]), rtol=1e-12)
+        assert np.asarray(s4.nonant_idx).tolist() == \
+            np.asarray(b.nonant_idx).tolist()
+        assert np.asarray(s4.tree.node_of[0]).tolist() == \
+            np.asarray(b.tree.node_of[4]).tolist()
+
+    def test_ef_golden(self):
+        """EF objective: reference asserts consensus E[obj] == 190 at
+        2 sig figs (test_ef_ph.py Test_hydro.test_ph_solve)."""
+        from mpisppy_tpu.opt.ef import ExtensiveForm
+        b = hydro.build_batch()
+        ef = ExtensiveForm({"pdhg_eps": 1e-8, "pdhg_max_iters": 60000},
+                           [f"Scen{i+1}" for i in range(9)], batch=b)
+        ef.solve_extensive_form()
+        obj = ef.get_objective_value()
+        assert round_pos_sig(obj, 2) == 190
+        # nonanticipativity holds: stage-2 nonants agree within groups
+        xna = np.asarray(ef.nonants())
+        for g in range(3):
+            grp = xna[3 * g:3 * g + 3, 4:]
+            assert np.max(np.abs(grp - grp[0])) < 1e-4
+        # stage-1 nonants agree across ALL scenarios
+        assert np.max(np.abs(xna[:, :4] - xna[0, :4])) < 1e-4
+
+    def test_ph_golden(self):
+        """PH on hydro: trivial bound 180, converged E[obj] 190
+        (reference Test_hydro.test_ph_solve)."""
+        from mpisppy_tpu.opt.ph import PH
+        b = hydro.build_batch()
+        ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 100,
+                 "convthresh": 1e-6, "pdhg_eps": 1e-8,
+                 "pdhg_max_iters": 40000},
+                [f"Scen{i+1}" for i in range(9)], batch=b)
+        conv, eobj, tbound = ph.ph_main()
+        assert round_pos_sig(tbound, 2) == 180
+        # evaluate the implementable consensus solution, stage-by-stage
+        inner, feas = ph.evaluate_xhat(ph.state.xbar)
+        assert feas
+        assert round_pos_sig(inner, 2) == 190
