@@ -1,0 +1,233 @@
+"""Hub classes (reference: mpisppy/cylinders/hub.py).
+
+The hub runs the main algorithm (PH/APH/L-shaped), pushes W / nonant /
+bound vectors to spokes, pulls bounds back, tracks BestInnerBound /
+BestOuterBound, and decides gap-based termination
+(rel_gap / abs_gap / max_stalled_iters — reference hub.py:125-161).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import global_toc
+from .spcommunicator import SPCommunicator, WindowPair
+from .spoke import ConvergerSpokeType
+
+
+class Hub(SPCommunicator):
+    def __init__(self, spbase_object, spokes=(), options=None):
+        super().__init__(spbase_object, options=options)
+        self.spokes = list(spokes)     # Spoke instances (wired later)
+        self.pairs = []                # WindowPair per spoke
+        # bound state (reference hub.py:229-239 initialize_bound_values)
+        if self.opt.is_minimizing:
+            self.BestInnerBound = np.inf
+            self.BestOuterBound = -np.inf
+            self._ib_better = lambda new, old: new < old
+            self._ob_better = lambda new, old: new > old
+        else:
+            self.BestInnerBound = -np.inf
+            self.BestOuterBound = np.inf
+            self._ib_better = lambda new, old: new > old
+            self._ob_better = lambda new, old: new < old
+        # screen trace state (reference hub.py:36-40, 111-123)
+        self.print_init = True
+        self.latest_ib_char = None
+        self.latest_ob_char = None
+        # stall tracking (reference hub.py:41-42)
+        self.stalled_iter_cnt = 0
+        self.last_gap = float("inf")
+        self.best_nonant_solution = None   # incumbent (K,) or (S,K)
+        # interleaved mode: the hub drives spoke.step() inline during
+        # sync() (single-program scheduling, SURVEY.md §7.6); threaded
+        # mode clears this and spokes loop in their own threads
+        self.drive_spokes_inline = True
+
+    # -- wiring (reference hub.py:297-368 initialize_spoke_indices +
+    #    make_windows) ----------------------------------------------------
+    def wire_spokes(self):
+        self.outerbound_idx, self.innerbound_idx = set(), set()
+        self.w_idx, self.nonant_idx_set = set(), set()
+        self.spoke_chars = {}
+        self.pairs = []
+        for i, sp in enumerate(self.spokes):
+            for cst in sp.converger_spoke_types:
+                if cst == ConvergerSpokeType.OUTER_BOUND:
+                    self.outerbound_idx.add(i)
+                elif cst == ConvergerSpokeType.INNER_BOUND:
+                    self.innerbound_idx.add(i)
+                elif cst == ConvergerSpokeType.W_GETTER:
+                    self.w_idx.add(i)
+                elif cst == ConvergerSpokeType.NONANT_GETTER:
+                    self.nonant_idx_set.add(i)
+            self.spoke_chars[i] = sp.converger_spoke_char
+            pair = WindowPair(hub_length=sp.receive_length(),
+                              spoke_length=sp.send_length())
+            sp.pair = pair
+            self.pairs.append(pair)
+        self._spoke_read_ids = np.zeros(len(self.spokes), np.int64)
+        self.has_outerbound_spokes = bool(self.outerbound_idx)
+        self.has_innerbound_spokes = bool(self.innerbound_idx)
+
+    # -- gap machinery (reference hub.py:77-161) --------------------------
+    def compute_gaps(self):
+        if self.opt.is_minimizing:
+            abs_gap = self.BestInnerBound - self.BestOuterBound
+        else:
+            abs_gap = self.BestOuterBound - self.BestInnerBound
+        if (np.isfinite(abs_gap) and np.isfinite(self.BestOuterBound)
+                and self.BestOuterBound != 0):
+            rel_gap = abs_gap / abs(self.BestOuterBound)
+        else:
+            rel_gap = float("inf")
+        return abs_gap, rel_gap
+
+    def determine_termination(self):
+        o = self.options
+        if not any(k in o for k in
+                   ("rel_gap", "abs_gap", "max_stalled_iters")):
+            return False
+        abs_gap, rel_gap = self.compute_gaps()
+        rel_ok = "rel_gap" in o and rel_gap <= o["rel_gap"]
+        abs_ok = "abs_gap" in o and abs_gap <= o["abs_gap"]
+        stalled = False
+        if "max_stalled_iters" in o:
+            if abs_gap < self.last_gap:
+                self.last_gap = abs_gap
+                self.stalled_iter_cnt = 0
+            else:
+                self.stalled_iter_cnt += 1
+                stalled = self.stalled_iter_cnt >= o["max_stalled_iters"]
+        if abs_ok:
+            global_toc(f"Terminating: abs gap {abs_gap:12.4f}")
+        if rel_ok:
+            global_toc(f"Terminating: rel gap {rel_gap*100:12.3f}%")
+        if stalled:
+            global_toc(f"Terminating: stalled {self.stalled_iter_cnt} iters")
+        return abs_ok or rel_ok or stalled
+
+    def screen_trace(self):
+        abs_gap, rel_gap = self.compute_gaps()
+        src = ((self.latest_ob_char or " ")
+               + " " + (self.latest_ib_char or " "))
+        if self.print_init:
+            global_toc(f'{"Iter.":>5s}  {"   "}  {"Best Bound":>14s}  '
+                       f'{"Best Incumbent":>14s}  {"Rel. Gap":>12s}  '
+                       f'{"Abs. Gap":>14s}')
+            self.print_init = False
+        global_toc(f"{self.current_iteration():5d}  {src}  "
+                   f"{self.BestOuterBound:14.4f}  "
+                   f"{self.BestInnerBound:14.4f}  "
+                   f"{rel_gap*100:12.3f}%  {abs_gap:14.4f}")
+        self.latest_ib_char = None
+        self.latest_ob_char = None
+
+    # -- bound intake (reference hub.py:174-227) --------------------------
+    def receive_outerbounds(self):
+        for i in self.outerbound_idx:
+            data, wid = self.pairs[i].to_hub.read()
+            if wid > self._spoke_read_ids[i]:
+                self._spoke_read_ids[i] = wid
+                self.OuterBoundUpdate(float(data[0]), i)
+
+    def receive_innerbounds(self):
+        for i in self.innerbound_idx:
+            data, wid = self.pairs[i].to_hub.read()
+            if wid > self._spoke_read_ids[i]:
+                self._spoke_read_ids[i] = wid
+                self.InnerBoundUpdate(float(data[0]), i)
+                sol = getattr(self.spokes[i], "best_solution", None)
+                if sol is not None and self.BestInnerBound == float(data[0]):
+                    self.best_nonant_solution = sol
+
+    def OuterBoundUpdate(self, new_bound, idx=None, char="*"):
+        if self._ob_better(new_bound, self.BestOuterBound):
+            self.latest_ob_char = (self.spoke_chars.get(idx, char)
+                                   if idx is not None else char)
+            self.BestOuterBound = new_bound
+        return self.BestOuterBound
+
+    def InnerBoundUpdate(self, new_bound, idx=None, char="*"):
+        if self._ib_better(new_bound, self.BestInnerBound):
+            self.latest_ib_char = (self.spoke_chars.get(idx, char)
+                                   if idx is not None else char)
+            self.BestInnerBound = new_bound
+        return self.BestInnerBound
+
+    # -- outbound (reference hub.py:370-436) ------------------------------
+    def send_terminate(self):
+        for pair in self.pairs:
+            pair.to_spoke.send_kill()
+
+    def hub_finalize(self):
+        self.receive_outerbounds()
+        self.receive_innerbounds()
+        global_toc("Statistics at termination")
+        self.print_init = True
+        self.screen_trace()
+
+    def current_iteration(self):
+        raise NotImplementedError
+
+    def main(self):
+        raise NotImplementedError
+
+
+class PHHub(Hub):
+    """PH as hub (reference hub.py:453-598): sync() sends Ws + nonants,
+    receives bounds; is_converged() seeds the outer bound with PH's
+    trivial bound and applies gap termination."""
+
+    def setup_hub(self):
+        self.wire_spokes()
+        self._iter_for_trace = 0
+
+    def sync(self):
+        self.send_ws()
+        self.send_nonants()
+        if self.drive_spokes_inline:
+            for sp in self.spokes:
+                sp.step()
+        self.receive_outerbounds()
+        self.receive_innerbounds()
+
+    def is_converged(self):
+        # seed outer bound with the trivial bound once (reference
+        # hub.py:519-547)
+        if (not np.isfinite(self.BestOuterBound)
+                and self.opt.trivial_bound is not None):
+            self.OuterBoundUpdate(self.opt.trivial_bound, char="B")
+        if not self.has_innerbound_spokes:
+            if self.opt.conv is not None and \
+                    self.opt.conv < self.options.get("convthresh", -1):
+                return True
+            return False
+        self.screen_trace()
+        return self.determine_termination()
+
+    def current_iteration(self):
+        st = self.opt.state
+        return int(st.it) if st is not None else 0
+
+    def main(self):
+        return self.opt.ph_main(finalize=False)
+
+    def send_nonants(self):
+        """Push current per-scenario nonant values (reference
+        hub.py:562)."""
+        st = self.opt.state
+        if st is None:
+            return
+        x_na = np.asarray(self.opt.batch.nonants(st.x)).reshape(-1)
+        for i in self.nonant_idx_set:
+            self.pairs[i].to_spoke.write(x_na)
+
+    def send_ws(self):
+        """Push current W (reference hub.py:590)."""
+        st = self.opt.state
+        if st is None:
+            return
+        W = np.asarray(st.W).reshape(-1)
+        for i in self.w_idx:
+            self.pairs[i].to_spoke.write(W)

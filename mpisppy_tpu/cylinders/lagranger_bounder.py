@@ -1,0 +1,65 @@
+"""Lagranger outer-bound spoke (reference:
+mpisppy/cylinders/lagranger_bounder.py): an INDEPENDENT Lagrangian that
+takes the hub's nonant values (not its Ws), maintains its own W via
+xbar/dual updates at its own rho, and reports the resulting dual
+bounds.  Optional per-iteration rho rescale factors
+(lagranger_rho_rescale_factors_json, reference :55-75) — scalings
+accumulate.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..phbase import compute_xbar, update_W
+from .spoke import OuterBoundNonantSpoke
+
+
+class LagrangerOuterBound(OuterBoundNonantSpoke):
+    converger_spoke_char = "A"
+
+    def __init__(self, spbase_object, options=None):
+        super().__init__(spbase_object, options=options)
+        b = self.opt.batch
+        rho0 = float(self.opt.options.get("defaultPHrho", 1.0))
+        self.rho = jnp.full((b.num_scens, b.num_nonants), rho0, b.c.dtype)
+        self.W = jnp.zeros((b.num_scens, b.num_nonants), b.c.dtype)
+        self._iter = 0
+        path = self.opt.options.get("lagranger_rho_rescale_factors_json")
+        self.rho_rescale_factors = None
+        if path is not None:
+            with open(path) as f:
+                din = json.load(f)
+            self.rho_rescale_factors = {int(i): float(v)
+                                        for i, v in din.items()}
+
+    def step(self):
+        x_na, is_new = self.fresh_nonants()
+        if self._killed or not is_new:
+            return False
+        return self._solve_pass(x_na)
+
+    def _solve_pass(self, x_na):
+        if self.rho_rescale_factors is not None and \
+                self._iter in self.rho_rescale_factors:
+            # scalings accumulate (reference lagranger_bounder.py:57)
+            self.rho = self.rho * self.rho_rescale_factors[self._iter]
+        b = self.opt.batch
+        x_na = jnp.asarray(np.asarray(x_na), b.c.dtype)
+        xbar, _ = compute_xbar(b, x_na)
+        self.W = update_W(self.W, self.rho, x_na, xbar)
+        c_eff = b.c.at[:, b.nonant_idx].add(self.W)
+        res = self.opt.solve_loop(c=c_eff, warm=True)
+        self.update_if_improving(float(self.opt.Ebound(res.dual_obj)))
+        self._iter += 1
+        return True
+
+    def finalize(self):
+        """Final bound pass with the last nonants, run AFTER the kill
+        signal (reference lagranger_bounder.py:106-116 finalize)."""
+        x_na, _ = self.fresh_nonants()
+        self._solve_pass(x_na)
+        return self.bound

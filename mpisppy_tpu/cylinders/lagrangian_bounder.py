@@ -1,0 +1,45 @@
+"""Lagrangian outer-bound spoke (reference:
+mpisppy/cylinders/lagrangian_bounder.py).
+
+Receives PH's W vectors from the hub, re-solves every scenario with the
+W-modified objective (NO prox term), and reports the probability-
+weighted dual bound.  Valid because the probability-weighted W sums to
+zero within each tree node by construction of the PH dual update.
+
+On TPU this spoke is nearly free: same batched PDHG kernel as the hub,
+different (c_eff) arrays, own warm-start cache (SURVEY.md §2.10).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .spoke import _BoundWSpoke
+
+
+class LagrangianOuterBound(_BoundWSpoke):
+    converger_spoke_char = "L"
+
+    def step(self):
+        W, is_new = self.fresh_Ws()
+        if self._killed or not is_new:
+            return False
+        b = self.opt.batch
+        c_eff = b.c.at[:, b.nonant_idx].add(jnp.asarray(W, b.c.dtype))
+        res = self.opt.solve_loop(c=c_eff, warm=True)
+        bound = float(self.opt.Ebound(res.dual_obj))
+        self.update_if_improving(bound)
+        return True
+
+    def finalize(self):
+        """One final pass with the last Ws (reference
+        lagrangian_bounder.py:84-95)."""
+        self.step_force()
+        return self.bound
+
+    def step_force(self):
+        W, _ = self.fresh_Ws()
+        b = self.opt.batch
+        c_eff = b.c.at[:, b.nonant_idx].add(jnp.asarray(W, b.c.dtype))
+        res = self.opt.solve_loop(c=c_eff, warm=True)
+        self.update_if_improving(float(self.opt.Ebound(res.dual_obj)))

@@ -1,0 +1,107 @@
+"""Inter-cylinder communication layer.
+
+Reference counterpart: mpisppy/cylinders/spcommunicator.py — one-sided
+MPI RMA windows per hub<->spoke pair; the writer Puts into its own
+buffer, the reader Gets the remote buffer, and the LAST slot of every
+buffer carries a monotonically increasing write_id that readers use to
+detect fresh vs. stale vs. torn data (spcommunicator.py:93-120,
+spoke.py:93-118, hub.py:411-431).  The kill signal is write_id = -1
+(hub.py:438-450).
+
+TPU-native redesign: cylinders are concurrent *algorithms* sharing one
+single-controller JAX process (interleaved on the device queue) or
+running in host threads; the exchange is therefore a host-side
+double-buffered mailbox with the same write_id semantics.  The
+`Window` interface below is deliberately identical in contract to the
+RMA pair so the multi-process DCN backend (C++ shared-memory exchange,
+runtime/exchange.cpp) can slot in behind it unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class Window:
+    """One direction of a hub<->spoke pair: a (length+1,) float64
+    buffer whose last slot is the write_id.
+
+    Contract (mirrors the reference RMA protocol):
+      * writes are atomic and carry a strictly increasing write_id
+      * `read()` returns (data_copy, write_id); the reader decides
+        freshness by comparing ids (reference spoke.py:99-118)
+      * write_id == -1 means terminate (reference hub.py:438)
+    """
+
+    KILL = -1
+
+    def __init__(self, length: int):
+        self.length = int(length)
+        self._buf = np.zeros(self.length + 1, dtype=np.float64)
+        self._lock = threading.Lock()
+
+    @property
+    def write_id(self):
+        with self._lock:
+            return int(self._buf[-1])
+
+    def write(self, values, write_id=None):
+        """Post `values` with the next (or given) write_id."""
+        values = np.asarray(values, dtype=np.float64)
+        if values.shape != (self.length,):
+            raise ValueError(
+                f"window expects shape ({self.length},), got {values.shape}")
+        with self._lock:
+            new_id = int(self._buf[-1]) + 1 if write_id is None else write_id
+            self._buf[:-1] = values
+            self._buf[-1] = new_id
+            return new_id
+
+    def read(self):
+        """(data copy, write_id) — one atomic snapshot."""
+        with self._lock:
+            return self._buf[:-1].copy(), int(self._buf[-1])
+
+    def send_kill(self):
+        with self._lock:
+            self._buf[-1] = self.KILL
+
+
+class WindowPair:
+    """The two windows of one hub<->spoke stratum: hub-owned (spoke
+    reads) and spoke-owned (hub reads) — the analog of the two
+    MPI.Win.Allocate buffers per pair (reference spcommunicator.py:93).
+    """
+
+    def __init__(self, hub_length: int, spoke_length: int):
+        self.to_spoke = Window(hub_length)
+        self.to_hub = Window(spoke_length)
+
+
+class SPCommunicator:
+    """Base for Hub and Spoke wrappers: owns an optimization object
+    (`opt`, an SPOpt subclass) and its window endpoints (reference
+    spcommunicator.py:24-92)."""
+
+    def __init__(self, spbase_object, options=None):
+        self.opt = spbase_object
+        self.options = dict(options or {})
+        self.opt.spcomm = self
+
+    # lengths of the vectors this cylinder sends/receives; subclasses
+    # override (reference: Spoke.make_windows sends its 2 lengths)
+    def send_length(self) -> int:
+        return 1
+
+    def receive_length(self) -> int:
+        return 1
+
+    def free_windows(self):
+        pass
+
+    def finalize(self):
+        """Last chance to do work after the kill signal (reference
+        spcommunicator.py finalize + spoke finalize passes)."""
+        return None

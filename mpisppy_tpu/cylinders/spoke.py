@@ -1,0 +1,198 @@
+"""Spoke base classes (reference: mpisppy/cylinders/spoke.py).
+
+Every spoke exposes ONE unit of work as `step()` — read fresh hub data,
+do a batched solve pass, post results.  The threaded driver loops
+`main()` = `while not killed: step()`; the interleaved (single-program)
+driver calls `step()` directly between hub iterations.  Both modes
+share all algorithm code.
+
+The spoke-type registry (`converger_spoke_types` /
+`converger_spoke_char`) drives hub buffer wiring exactly like the
+reference (spoke.py:18-33).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import time
+
+import numpy as np
+
+from .spcommunicator import SPCommunicator, Window
+
+
+class ConvergerSpokeType(enum.Enum):
+    OUTER_BOUND = 1
+    INNER_BOUND = 2
+    W_GETTER = 3
+    NONANT_GETTER = 4
+
+
+class Spoke(SPCommunicator):
+    converger_spoke_types = ()
+    converger_spoke_char = "?"
+
+    def __init__(self, spbase_object, options=None):
+        super().__init__(spbase_object, options=options)
+        self.pair = None           # WindowPair, set by the wheel
+        self.last_hub_id = 0
+        self._killed = False
+
+    # -- hub traffic (reference spoke.py:60-118) --------------------------
+    def spoke_to_hub(self, values):
+        """Post this spoke's vector (reference spoke.py:60)."""
+        self.pair.to_hub.write(values)
+
+    def spoke_from_hub(self):
+        """(data, is_new): latest hub vector; is_new iff the write_id
+        advanced since our last read (reference spoke.py:93-118)."""
+        data, wid = self.pair.to_spoke.read()
+        if wid == Window.KILL:
+            self._killed = True
+            return data, False
+        is_new = wid > self.last_hub_id
+        self.last_hub_id = max(self.last_hub_id, wid)
+        return data, is_new
+
+    def got_kill_signal(self):
+        if not self._killed:
+            self._killed = self.pair.to_spoke.write_id == Window.KILL
+        return self._killed
+
+    def get_serial_number(self):
+        wid = self.pair.to_spoke.write_id
+        return 0 if wid == Window.KILL else wid
+
+    # -- work unit --------------------------------------------------------
+    def step(self):
+        """One unit of spoke work; subclasses implement.  Returns
+        truthy iff work was done (fresh data was consumed) — the
+        threaded loop backs off when a step was a no-op."""
+        raise NotImplementedError
+
+    def main(self):
+        """Threaded-mode driver loop (reference: each spoke's main)."""
+        while not self.got_kill_signal():
+            if self.get_serial_number() == 0 or not self.step():
+                # nothing fresh from the hub yet — don't busy-spin
+                time.sleep(1e-3)
+
+
+class _BoundSpoke(Spoke):
+    """A spoke that sends a scalar bound (reference spoke.py:147-208).
+    Supports the per-spoke bound trace CSV via options["trace_prefix"].
+    """
+
+    def __init__(self, spbase_object, options=None):
+        super().__init__(spbase_object, options=options)
+        self.bound = (np.inf if self._is_inner_like()
+                      else -np.inf) * (1 if self.opt.is_minimizing else -1)
+        self._got_bound = False
+        self._trace_path = None
+        prefix = self.options.get("trace_prefix")
+        if prefix is not None:
+            self._trace_path = (
+                f"{prefix}_{type(self).__name__}.csv")
+            os.makedirs(os.path.dirname(self._trace_path) or ".",
+                        exist_ok=True)
+            with open(self._trace_path, "w") as f:
+                f.write("time,bound\n")
+            self._t0 = time.time()
+
+    def _is_inner_like(self):
+        return ConvergerSpokeType.INNER_BOUND in self.converger_spoke_types
+
+    def send_length(self):
+        return 1
+
+    def update_if_improving(self, candidate):
+        """Keep + send the bound if it improves (reference
+        spoke.py:186-202)."""
+        if candidate is None or not np.isfinite(candidate):
+            return False
+        if self.opt.is_minimizing:
+            better = (candidate < self.bound if self._is_inner_like()
+                      else candidate > self.bound)
+        else:
+            better = (candidate > self.bound if self._is_inner_like()
+                      else candidate < self.bound)
+        if better or not self._got_bound:
+            self.bound = float(candidate)
+            self._got_bound = True
+            self.spoke_to_hub([self.bound])
+            self._append_trace(self.bound)
+            return bool(better)
+        return False
+
+    def _append_trace(self, value):
+        """Reference spoke.py:204 _append_trace."""
+        if self._trace_path is None:
+            return
+        with open(self._trace_path, "a") as f:
+            f.write(f"{time.time() - self._t0},{value}\n")
+
+
+class _BoundWSpoke(_BoundSpoke):
+    """Bound spoke that receives the hub's W vector (flattened (S*K,))
+    (reference spoke.py:254-270 localWs)."""
+
+    converger_spoke_types = (ConvergerSpokeType.OUTER_BOUND,
+                             ConvergerSpokeType.W_GETTER)
+
+    def receive_length(self):
+        b = self.opt.batch
+        return b.num_scens * b.num_nonants
+
+    @property
+    def localWs(self):
+        data, _ = self.spoke_from_hub()
+        b = self.opt.batch
+        return data.reshape(b.num_scens, b.num_nonants)
+
+    def fresh_Ws(self):
+        """(W (S,K), is_new)"""
+        data, is_new = self.spoke_from_hub()
+        b = self.opt.batch
+        return data.reshape(b.num_scens, b.num_nonants), is_new
+
+
+class _BoundNonantSpoke(_BoundSpoke):
+    """Bound spoke that receives the hub's nonant values (flattened
+    (S*K,)) (reference spoke.py:288-303 localnonants)."""
+
+    def receive_length(self):
+        b = self.opt.batch
+        return b.num_scens * b.num_nonants
+
+    def fresh_nonants(self):
+        data, is_new = self.spoke_from_hub()
+        b = self.opt.batch
+        return data.reshape(b.num_scens, b.num_nonants), is_new
+
+    @property
+    def localnonants(self):
+        return self.fresh_nonants()[0]
+
+
+class InnerBoundNonantSpoke(_BoundNonantSpoke):
+    """Inner-bound spoke consuming hub nonants; tracks the incumbent
+    first-stage solution (reference spoke.py:306-363)."""
+
+    converger_spoke_types = (ConvergerSpokeType.INNER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)
+
+    def __init__(self, spbase_object, options=None):
+        super().__init__(spbase_object, options=options)
+        self.best_solution = None      # (K,) or (S, K) incumbent nonants
+
+    def update_if_improving(self, candidate, solution=None):
+        updated = super().update_if_improving(candidate)
+        if updated and solution is not None:
+            self.best_solution = np.asarray(solution)
+        return updated
+
+
+class OuterBoundNonantSpoke(_BoundNonantSpoke):
+    converger_spoke_types = (ConvergerSpokeType.OUTER_BOUND,
+                             ConvergerSpokeType.NONANT_GETTER)

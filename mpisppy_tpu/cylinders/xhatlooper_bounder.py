@@ -1,0 +1,41 @@
+"""Xhat sequential-looper inner-bound spoke (reference:
+mpisppy/cylinders/xhatlooper_bounder.py): like the shuffler but walks
+scenarios in their given order, up to `scen_limit` per pass."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.xhat_utils import (candidate_from_sources, full_source_map,
+                                node_members, round_integer_nonants)
+from .spoke import InnerBoundNonantSpoke
+
+
+class XhatLooperInnerBound(InnerBoundNonantSpoke):
+    converger_spoke_char = "X"
+
+    def __init__(self, spbase_object, options=None):
+        super().__init__(spbase_object, options=options)
+        self.scen_limit = int(self.options.get("scen_limit", 3))
+        self._next = 0
+        n_real = self.opt.n_real_scens
+        self._members = node_members(
+            np.asarray(self.opt.batch.tree.node_of)[:n_real])
+
+    def step(self):
+        x_na, is_new = self.fresh_nonants()
+        if self._killed or not is_new:
+            return False
+        x_na = np.asarray(x_na)
+        node_of = np.asarray(self.opt.batch.tree.node_of)
+        n_real = self.opt.n_real_scens
+        for _ in range(self.scen_limit):
+            base = self._next % n_real
+            self._next += 1
+            srcs = full_source_map(node_of, base, members=self._members)
+            cand = candidate_from_sources(x_na, node_of, srcs)
+            cand = round_integer_nonants(self.opt.batch, cand)
+            obj, feas = self.opt.evaluate_xhat(cand)
+            if feas:
+                self.update_if_improving(obj, solution=cand)
+        return True

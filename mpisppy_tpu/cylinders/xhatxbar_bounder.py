@@ -1,0 +1,28 @@
+"""Xhat-xbar inner-bound spoke (reference:
+mpisppy/cylinders/xhatxbar_bounder.py): the candidate is the consensus
+average x̄ itself (rounded on integer slots)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..phbase import compute_xbar
+from ..utils.xhat_utils import round_integer_nonants
+from .spoke import InnerBoundNonantSpoke
+
+
+class XhatXbarInnerBound(InnerBoundNonantSpoke):
+    converger_spoke_char = "B"
+
+    def step(self):
+        x_na, is_new = self.fresh_nonants()
+        if self._killed or not is_new:
+            return False
+        b = self.opt.batch
+        xbar, _ = compute_xbar(b, jnp.asarray(np.asarray(x_na), b.c.dtype))
+        cand = round_integer_nonants(b, np.asarray(xbar))
+        obj, feas = self.opt.evaluate_xhat(cand)
+        if feas:
+            self.update_if_improving(obj, solution=cand)
+        return True

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import global_toc
-from ..ops.pdhg import ConsensusSpec, prepare_batch
+from ..ops.pdhg import ConsensusSpec
 from ..spopt import SPOpt
 
 

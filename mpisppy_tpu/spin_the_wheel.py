@@ -1,0 +1,135 @@
+"""WheelSpinner — multi-cylinder orchestration (reference:
+mpisppy/spin_the_wheel.py, 237 LoC).
+
+The reference splits COMM_WORLD into a (cylinder x scenario-shard) rank
+grid and runs hub+spokes as separate MPI programs tied by RMA windows
+(spin_the_wheel.py:219-237).  The TPU-native default is **interleaved
+single-program scheduling** (SURVEY.md §7.6): the hub's PH loop and
+every spoke's batched solve share one device queue — after each hub
+iteration, PHHub.sync() pushes W/nonants, drives each spoke's `step()`
+inline, and pulls bounds.  A `threads` mode runs each spoke's `main()`
+loop in a host thread against the same Window protocol — the layout
+that extends to multi-host DCN exchange.
+
+Dict schema mirrors the reference / vanilla factories:
+    hub_dict  = {"hub_class": PHHub, "hub_kwargs": {"options": {...}},
+                 "opt_class": PH,    "opt_kwargs": {...}}
+    spoke_dict = {"spoke_class": ..., "spoke_kwargs": {"options": ...},
+                  "opt_class": ...,   "opt_kwargs": {...}}
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from . import global_toc
+
+
+class WheelSpinner:
+    def __init__(self, hub_dict, list_of_spoke_dict=(), mode="interleaved"):
+        self._validate(hub_dict, list_of_spoke_dict)
+        self.hub_dict = hub_dict
+        self.list_of_spoke_dict = list(list_of_spoke_dict)
+        self.mode = mode
+        self.spcomm = None
+        self._ran = False
+
+    @staticmethod
+    def _validate(hub_dict, spoke_dicts):
+        """Reference spin_the_wheel.py:48-78 dict validation."""
+        for k in ("hub_class", "opt_class", "opt_kwargs"):
+            if k not in hub_dict:
+                raise RuntimeError(f"hub_dict missing key {k}")
+        for sd in spoke_dicts:
+            for k in ("spoke_class", "opt_class", "opt_kwargs"):
+                if k not in sd:
+                    raise RuntimeError(f"spoke_dict missing key {k}")
+
+    # -- lifecycle (reference spin_the_wheel.py:119-144) ------------------
+    def spin(self):
+        hd = self.hub_dict
+        global_toc("WheelSpinner: constructing hub optimizer")
+        hub_opt = hd["opt_class"](**hd["opt_kwargs"])
+
+        spokes = []
+        for sd in self.list_of_spoke_dict:
+            kw = dict(sd["opt_kwargs"])
+            # all cylinders share ONE lowered batch + mesh placement —
+            # the analog of each cylinder building its own SPBase
+            # (reference :106-108), minus the duplicate model build
+            kw.setdefault("batch", hub_opt.batch)
+            kw.setdefault("mesh", hub_opt.mesh)
+            sp_opt = sd["opt_class"](**kw)
+            spoke = sd["spoke_class"](
+                sp_opt, options=sd.get("spoke_kwargs", {}).get("options"))
+            spokes.append(spoke)
+
+        hub = hd["hub_class"](
+            hub_opt, spokes,
+            options=hd.get("hub_kwargs", {}).get("options"))
+        hub.setup_hub()
+        self.spcomm = hub
+
+        if self.mode == "threads" and spokes:
+            hub.drive_spokes_inline = False
+            threads = [threading.Thread(target=sp.main, daemon=True)
+                       for sp in spokes]
+            for t in threads:
+                t.start()
+            hub.main()
+            hub.send_terminate()
+            # unbounded join: spokes exit after their current step (a
+            # bounded batched solve); finalizing while a spoke thread
+            # still runs would race on its opt's warm-start caches
+            for t in threads:
+                t.join()
+        else:
+            hub.drive_spokes_inline = True
+            hub.main()
+            hub.send_terminate()
+
+        # final spoke passes (reference :129-139 "finalize")
+        for sp in spokes:
+            try:
+                sp.finalize()
+            except Exception as e:  # a failing final pass must not eat
+                global_toc(f"spoke finalize failed: {e}")  # the results
+        hub.hub_finalize()
+        self._ran = True
+        return self
+
+    # -- results (reference spin_the_wheel.py:152-217) --------------------
+    @property
+    def BestInnerBound(self):
+        return self.spcomm.BestInnerBound
+
+    @property
+    def BestOuterBound(self):
+        return self.spcomm.BestOuterBound
+
+    def on_hub(self):
+        return True  # single-controller: every caller sees the hub
+
+    def best_nonant_solution(self):
+        """Incumbent (S, K) or (K,) nonants from the winning inner-bound
+        spoke, falling back to the hub's consensus xbar."""
+        sol = self.spcomm.best_nonant_solution
+        if sol is None and self.spcomm.opt.state is not None:
+            sol = np.asarray(self.spcomm.opt.state.xbar)
+        return sol
+
+    def write_first_stage_solution(self, path):
+        sol = self.best_nonant_solution()
+        if sol is None:
+            raise RuntimeError("no solution available")
+        root = sol if sol.ndim == 1 else sol[0]
+        K = self.spcomm.opt.batch.num_nonants
+        self.spcomm.opt.write_first_stage_solution(path, root[:K])
+
+    def write_tree_solution(self, directory):
+        opt = self.spcomm.opt
+        if opt.state is None:
+            raise RuntimeError("hub has no solution state")
+        opt.write_tree_solution(directory, opt.state.x)

@@ -1,0 +1,71 @@
+"""Candidate (xhat) construction helpers shared by xhat spokes and
+in-hub xhat extensions (reference: mpisppy/extensions/xhatbase.py:38
+_try_one walks the tree picking a source scenario per node and copying
+its nonant values; cylinders/xhatshufflelooper_bounder.py ScenarioCycler
+builds the node->scenario dicts).
+
+Array form: a candidate is a (S, K) matrix of nonant values, built by
+gathering value slot j of scenario s from the SOURCE scenario assigned
+to the tree node owning (s, j).  For a two-stage problem that is one
+row broadcast; multistage gets per-node sources.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def node_members(node_of):
+    """{node_id: sorted list of scenario indices through that node},
+    derived purely from the batch's node_of array (no tree object
+    needed)."""
+    node_of = np.asarray(node_of)
+    out = {}
+    for s in range(node_of.shape[0]):
+        for n in np.unique(node_of[s]):
+            out.setdefault(int(n), []).append(s)
+    return out
+
+
+def full_source_map(node_of, base_scen, members=None):
+    """(num_used_nodes,)-dict {node: src}: base_scen wherever it passes
+    through; else the smallest-index member scenario.  The analog of
+    completing a partial xhat scenario dict over the whole tree."""
+    node_of = np.asarray(node_of)
+    if members is None:
+        members = node_members(node_of)
+    base_nodes = set(int(n) for n in np.unique(node_of[base_scen]))
+    return {n: (base_scen if n in base_nodes else mem[0])
+            for n, mem in members.items()}
+
+
+def candidate_from_sources(x_na, node_of, node_to_src):
+    """(S, K) candidate: value (s, j) taken from scenario
+    node_to_src[node_of[s, j]].
+
+    x_na: (S, K) per-scenario nonant values; node_to_src: dict or
+    (num_nodes,) array."""
+    x_na = np.asarray(x_na)
+    node_of = np.asarray(node_of)
+    if isinstance(node_to_src, dict):
+        arr = np.zeros(int(node_of.max()) + 1, np.int64)
+        for n, s in node_to_src.items():
+            arr[int(n)] = int(s)
+        node_to_src = arr
+    srcs = node_to_src[node_of]                       # (S, K)
+    return np.take_along_axis(x_na, srcs, axis=0)
+
+
+def round_integer_nonants(batch, candidate):
+    """Round candidate values on integer nonant slots (the fix-and-
+    round MIP recovery step; reference xhat machinery relies on the
+    solver for integrality — here integrality is restored by rounding
+    before the fixed evaluation)."""
+    cand = np.asarray(candidate, dtype=float).copy()
+    imask = np.asarray(batch.integer_mask)[:, np.asarray(batch.nonant_idx)]
+    if cand.ndim == 1:
+        imask0 = imask[0] if imask.ndim == 2 else imask
+        cand[imask0] = np.round(cand[imask0])
+    else:
+        cand[imask] = np.round(cand[imask])
+    return cand
