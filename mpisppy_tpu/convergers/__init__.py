@@ -1,0 +1,1 @@
+from .converger import Converger  # noqa: F401

@@ -1,0 +1,37 @@
+"""NormRhoConverger (reference: mpisppy/convergers/norm_rho_converger.py:12).
+
+Declares convergence when the rho-weighted primal residual
+    sum_s p_s || rho * (x_s - xbar) ||_1 / K
+drops below options["norm_rho_converger_tol"] (default 1e-4) — the dual
+step size PH is about to take.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import global_toc
+from .converger import Converger
+
+
+class NormRhoConverger(Converger):
+    def __init__(self, opt):
+        super().__init__(opt)
+        self.tol = float(opt.options.get("norm_rho_converger_tol", 1e-4))
+
+    def is_converged(self):
+        st = self.opt.state
+        if st is None:
+            return False
+        b = self.opt.batch
+        x_na = np.asarray(b.nonants(st.x))
+        xbar = np.asarray(st.xbar)
+        rho = np.asarray(self.opt.rho)
+        p = np.asarray(b.prob)[:, None]
+        val = float(np.sum(p * np.abs(rho * (x_na - xbar)))
+                    / max(x_na.shape[1], 1))
+        self.convergence_value = val
+        if val < self.tol:
+            global_toc(f"NormRhoConverger: {val:.3e} < {self.tol}")
+            return True
+        return False

@@ -231,3 +231,12 @@ class PHHub(Hub):
         W = np.asarray(st.W).reshape(-1)
         for i in self.w_idx:
             self.pairs[i].to_spoke.write(W)
+
+
+class APHHub(PHHub):
+    """APH as hub (reference hub.py:691-771): same wire protocol as
+    PHHub; main() runs APH_main.  The reference skips the pre-Put
+    barrier for asynchrony — moot here (single-program scheduling)."""
+
+    def main(self):
+        return self.opt.APH_main(spcomm=self, finalize=False)

@@ -20,26 +20,24 @@ from .spoke import _BoundWSpoke
 class LagrangianOuterBound(_BoundWSpoke):
     converger_spoke_char = "L"
 
+    def _solve_pass(self, W):
+        """W-only re-solve + dual bound (reference
+        lagrangian_bounder.py:44-60 lagrangian())."""
+        b = self.opt.batch
+        c_eff = b.c.at[:, b.nonant_idx].add(jnp.asarray(W, b.c.dtype))
+        res = self.opt.solve_loop(c=c_eff, warm=True)
+        self.update_if_improving(float(self.opt.Ebound(res.dual_obj)))
+
     def step(self):
         W, is_new = self.fresh_Ws()
         if self._killed or not is_new:
             return False
-        b = self.opt.batch
-        c_eff = b.c.at[:, b.nonant_idx].add(jnp.asarray(W, b.c.dtype))
-        res = self.opt.solve_loop(c=c_eff, warm=True)
-        bound = float(self.opt.Ebound(res.dual_obj))
-        self.update_if_improving(bound)
+        self._solve_pass(W)
         return True
 
     def finalize(self):
         """One final pass with the last Ws (reference
         lagrangian_bounder.py:84-95)."""
-        self.step_force()
-        return self.bound
-
-    def step_force(self):
         W, _ = self.fresh_Ws()
-        b = self.opt.batch
-        c_eff = b.c.at[:, b.nonant_idx].add(jnp.asarray(W, b.c.dtype))
-        res = self.opt.solve_loop(c=c_eff, warm=True)
-        self.update_if_improving(float(self.opt.Ebound(res.dual_obj)))
+        self._solve_pass(W)
+        return self.bound

@@ -109,14 +109,9 @@ class _BoundSpoke(Spoke):
     def update_if_improving(self, candidate):
         """Keep + send the bound if it improves (reference
         spoke.py:186-202)."""
-        if candidate is None or not np.isfinite(candidate):
+        if not self._improves(candidate):
             return False
-        if self.opt.is_minimizing:
-            better = (candidate < self.bound if self._is_inner_like()
-                      else candidate > self.bound)
-        else:
-            better = (candidate > self.bound if self._is_inner_like()
-                      else candidate < self.bound)
+        better = self._strictly_better(candidate)
         if better or not self._got_bound:
             self.bound = float(candidate)
             self._got_bound = True
@@ -124,6 +119,16 @@ class _BoundSpoke(Spoke):
             self._append_trace(self.bound)
             return bool(better)
         return False
+
+    def _improves(self, candidate):
+        return candidate is not None and np.isfinite(candidate)
+
+    def _strictly_better(self, candidate):
+        if self.opt.is_minimizing:
+            return (candidate < self.bound if self._is_inner_like()
+                    else candidate > self.bound)
+        return (candidate > self.bound if self._is_inner_like()
+                else candidate < self.bound)
 
     def _append_trace(self, value):
         """Reference spoke.py:204 _append_trace."""
@@ -146,7 +151,9 @@ class _BoundWSpoke(_BoundSpoke):
 
     @property
     def localWs(self):
-        data, _ = self.spoke_from_hub()
+        """Pure read of the hub's latest W — does NOT consume the
+        freshness flag (use fresh_Ws in step loops)."""
+        data, _ = self.pair.to_spoke.read()
         b = self.opt.batch
         return data.reshape(b.num_scens, b.num_nonants)
 
@@ -172,7 +179,10 @@ class _BoundNonantSpoke(_BoundSpoke):
 
     @property
     def localnonants(self):
-        return self.fresh_nonants()[0]
+        """Pure read — does NOT consume the freshness flag."""
+        data, _ = self.pair.to_spoke.read()
+        b = self.opt.batch
+        return data.reshape(b.num_scens, b.num_nonants)
 
 
 class InnerBoundNonantSpoke(_BoundNonantSpoke):
@@ -187,10 +197,14 @@ class InnerBoundNonantSpoke(_BoundNonantSpoke):
         self.best_solution = None      # (K,) or (S, K) incumbent nonants
 
     def update_if_improving(self, candidate, solution=None):
-        updated = super().update_if_improving(candidate)
-        if updated and solution is not None:
+        # record the incumbent BEFORE posting the bound: in threaded
+        # mode the hub may read the window between the post and a
+        # later assignment, pairing the new bound with a stale solution
+        if (solution is not None and self._improves(candidate)
+                and (self._strictly_better(candidate)
+                     or not self._got_bound)):
             self.best_solution = np.asarray(solution)
-        return updated
+        return super().update_if_improving(candidate)
 
 
 class OuterBoundNonantSpoke(_BoundNonantSpoke):

@@ -1,0 +1,1 @@
+from .extension import Extension, MultiExtension  # noqa: F401

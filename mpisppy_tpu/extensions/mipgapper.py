@@ -1,0 +1,40 @@
+"""Gapper — per-iteration solver-tolerance schedule (reference:
+mpisppy/extensions/mipgapper.py:11-57).
+
+The reference sets the MIP solver's mipgap from a {iteration: gap}
+dict.  Here the inner solver is the batched PDHG kernel, whose
+relative-KKT tolerance `eps` is a traced argument (ops/pdhg.py), so the
+schedule tightens/loosens the solve without recompiling.
+
+Options: options["gapperoptions"] = {"verbose": ..., "mipgapdict":
+{iter: eps}} — iteration 0 applies from Iter0 onward.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import global_toc
+from .extension import Extension
+
+
+class Gapper(Extension):
+    def __init__(self, ph):
+        super().__init__(ph)
+        o = ph.options.get("gapperoptions") or {}
+        self.verbose = bool(o.get("verbose", False))
+        self.mipgapdict = dict(o.get("mipgapdict") or {})
+
+    def _apply(self, it):
+        if it in self.mipgapdict:
+            eps = float(self.mipgapdict[it])
+            self.opt.solver_eps = jnp.asarray(eps, self.opt.batch.c.dtype)
+            if self.verbose:
+                global_toc(f"Gapper: iter {it} -> solver eps {eps:g}")
+
+    def pre_iter0(self):
+        self._apply(0)
+
+    def miditer(self):
+        if self.opt.state is not None:
+            self._apply(int(self.opt.state.it))

@@ -1,0 +1,68 @@
+"""NormRhoUpdater — adaptive rho from primal/dual residual norms
+(reference: mpisppy/extensions/norm_rho_updater.py:33-164).
+
+Standard ADMM-style residual balancing on PH's consensus split:
+    primal residual  r = sum_s p_s ||x_s - xbar||_1
+    dual residual    d = rho * ||xbar - xbar_prev||_1
+rho is scaled up when the primal residual dominates (consensus lagging)
+and down when the dual residual dominates, exactly the balancing logic
+the reference applies per-variable; we apply it per nonant slot with
+prob-weighted norms, vectorized.
+
+Options under options["norm_rho_options"]:
+    ratio (default 10.0), step (default 2.0 multiply/divide factor),
+    rho_update_stop_iter, verbose
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import global_toc
+from .extension import Extension
+
+
+class NormRhoUpdater(Extension):
+    def __init__(self, ph):
+        super().__init__(ph)
+        o = ph.options.get("norm_rho_options") or {}
+        self.ratio = float(o.get("ratio", 10.0))
+        self.step = float(o.get("step", 2.0))
+        self.stop_iter = o.get("rho_update_stop_iter")
+        self.verbose = bool(o.get("verbose", False))
+        self._xbar_prev = None
+
+    def miditer(self):
+        st = self.opt.state
+        if st is None:
+            return
+        it = int(st.it)
+        if self.stop_iter is not None and it > int(self.stop_iter):
+            return
+        b = self.opt.batch
+        xbar = np.asarray(st.xbar)
+        if self._xbar_prev is None:
+            self._xbar_prev = xbar
+            return
+        p = np.asarray(b.prob)[:, None]
+        x_na = np.asarray(b.nonants(st.x))
+        # per-slot prob-weighted residuals (K,)
+        prim = np.sum(p * np.abs(x_na - xbar), axis=0)
+        rho_np = np.asarray(self.opt.rho)
+        dual = np.mean(rho_np, axis=0) * np.sum(
+            p * np.abs(xbar - self._xbar_prev), axis=0)
+        self._xbar_prev = xbar
+
+        up = prim > self.ratio * dual
+        dn = dual > self.ratio * prim
+        if up.any() or dn.any():
+            factor = np.where(up, self.step,
+                              np.where(dn, 1.0 / self.step, 1.0))
+            new_rho = rho_np * factor[None, :]
+            self.opt.rho = jnp.asarray(new_rho, b.c.dtype)
+            if self.verbose:
+                global_toc(f"NormRhoUpdater iter {it}: "
+                           f"{int(up.sum())} slots up, "
+                           f"{int(dn.sum())} down; "
+                           f"mean rho {float(new_rho.mean()):.4g}")

@@ -287,11 +287,15 @@ class PDHGSolver:
     # -- public ----------------------------------------------------------
     def solve(self, prep: PreparedBatch, c, qdiag, lb, ub,
               obj_const=None, x0=None, y0=None,
-              consensus: ConsensusSpec | None = None) -> SolveResult:
+              consensus: ConsensusSpec | None = None,
+              eps=None) -> SolveResult:
         """Solve the batch.  c/qdiag/lb/ub are UNSCALED user-space arrays
         (S, N); x0/y0 optional warm starts in user space.  With a
         ConsensusSpec, solves the monolithic EF (prep must come from
-        prepare_batch(shared_cols=True))."""
+        prepare_batch(shared_cols=True)).  `eps` (a jnp scalar) overrides
+        the construction-time tolerance without recompiling — the analog
+        of per-iteration solver mipgap schedules (reference
+        extensions/mipgapper.py)."""
         S, N = c.shape
         M = prep.A.shape[1]
         if obj_const is None:
@@ -301,11 +305,11 @@ class PDHGSolver:
         if y0 is None:
             y0 = jnp.zeros((S, M), c.dtype)
         return self._solve_jit(prep, c, qdiag, lb, ub, obj_const, x0, y0,
-                               consensus)
+                               consensus, eps)
 
     # -- impl --------------------------------------------------------
     def _solve_impl(self, prep, c, qdiag, lb, ub, obj_const, x0, y0,
-                    consensus=None):
+                    consensus=None, eps=None):
         dc, dr = prep.d_col, prep.d_row
         # scale into solver space
         cs = c * dc
@@ -320,7 +324,11 @@ class PDHGSolver:
         # clamp the tolerance to what the dtype can express: in float32
         # an eps below ~1e-5 can never be met and every solve would spin
         # to max_iters
-        eps = max(self.eps, 100.0 * float(jnp.finfo(cs.dtype).eps))
+        floor = 100.0 * float(jnp.finfo(cs.dtype).eps)
+        if eps is None:
+            eps = max(self.eps, floor)
+        else:
+            eps = jnp.maximum(jnp.asarray(eps, cs.dtype), floor)
 
         if consensus is not None:
             from ..ir import node_segment_sum

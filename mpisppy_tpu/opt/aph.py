@@ -1,0 +1,216 @@
+"""APH — Asynchronous Projective Hedging (reference: mpisppy/opt/aph.py,
+982 LoC; Eckstein/Watson/Woodruff projective splitting).
+
+The reference hides solver latency behind a listener THREAD doing
+continuous Allreduces (utils/listener_util) and dispatches only a
+fraction of subproblems per pass (APH_solve_loop, aph.py:554-669).  On
+TPU the "solver" is one batched kernel, so the listener disappears
+(SURVEY.md §2.3): every reduction is a fused array op inside one jitted
+superstep.  What survives — because it changes the ALGORITHM, not just
+the schedule — is the **dispatch fraction**: per iteration only the
+`dispatch_frac` least-recently-dispatched scenarios refresh their
+(x, y); the rest contribute stale values to the averages, exactly the
+asynchronous trajectory of the reference.
+
+Per-iteration math (mirrors aph.py:332-530):
+    solve:  x_s  <- argmin f_s(x) + W_s.x_na + rho/2 ||x_na - z_s||^2
+    y_s   = W_s + rho (x_na - z)                    (Update_y, :151-182)
+    xbar  = node-avg x_na ; ybar = node-avg y       (Compute_Averages)
+    u_s   = x_na - xbar ;  v = ybar
+    tau   = E_s[ ||u_s||^2 + ||v_s||^2 / gamma ]    (side gig, :271-289)
+    phi   = E_s[ (z - x_na).(W - y) ]               (compute_phis_summand)
+    theta = nu * phi / tau  if phi>0, tau>0 else 0  (Update_theta_zw)
+    W    += theta * u ;  z += theta * ybar / gamma
+    conv  = ||u||_p/||W||_p + ||v||_p/||z||_p       (Compute_Convergence)
+
+Iteration 1 is special (reference :481-485): z := xbar, y := 0.
+
+Options: APHgamma (>0, default 1), APHnu (in (0,2), default 1),
+dispatch_frac (default 1.0 = synchronous), plus the PH options.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .. import global_toc
+from ..ir import node_segment_sum
+from ..phbase import PHBase, compute_xbar, convergence_metric
+
+
+def _register(cls, data_fields, meta_fields=()):
+    jax.tree_util.register_dataclass(
+        cls, data_fields=data_fields, meta_fields=meta_fields)
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class APHState:
+    x: Any             # (S, N) last primal solutions (possibly stale)
+    y: Any             # (S, M) row duals from last dispatched solve
+    y_na: Any          # (S, K) APH subgradients on nonants
+    W: Any             # (S, K)
+    z: Any             # (S, K) consensus point (node-consistent)
+    xbar: Any          # (S, K)
+    xsqbar: Any        # (S, K)
+    ybar: Any          # (S, K)
+    obj: Any           # (S,)
+    dual_obj: Any      # (S,)
+    conv: Any          # ()
+    theta: Any         # ()
+    phi: Any           # ()
+    tau: Any           # ()
+    it: Any            # () int32
+    last_dispatch: Any  # (S,) int32 — iteration each scenario last solved
+
+
+_register(APHState, tuple(f.name for f in dataclasses.fields(APHState)))
+
+
+def node_average(batch, v):
+    """Node-conditional probability-weighted average of a (S, K) array
+    (the FirstReduce of the reference, aph.py:394-407)."""
+    tree = batch.tree
+    p = tree.prob[:, None]
+    _, segsum = node_segment_sum(tree.node_of, tree.num_nodes)
+    wsum = jnp.maximum(segsum(jnp.broadcast_to(p, v.shape)), 1e-30)
+    return segsum(p * v) / wsum
+
+
+class APH(PHBase):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        o = self.options
+        self.APHgamma = float(o.get("APHgamma", 1.0))
+        self.APHnu = float(o.get("APHnu", 1.0))
+        frac = float(o.get("dispatch_frac", 1.0))
+        S = self.batch.num_scens
+        self.n_dispatch = max(1, min(S, int(jnp.ceil(frac * S))))
+        self.aph_state: APHState | None = None
+        self._aph_superstep = jax.jit(self._aph_superstep_impl)
+
+    # -- one APH iteration, fully fused -----------------------------------
+    def _aph_superstep_impl(self, st: APHState, rho, lb, ub, eps):
+        b = self.batch
+        S = b.num_scens
+        na = b.nonant_idx
+
+        # dispatch selection: the n least-recently-dispatched scenarios
+        # (reference dispatchrecord sort, aph.py:554-669); index breaks
+        # ties so the rotation is deterministic
+        key = st.last_dispatch * S + jnp.arange(S, dtype=jnp.int32)
+        _, idx = jax.lax.top_k(-key, self.n_dispatch)
+        mask = jnp.zeros((S,), bool).at[idx].set(True)
+
+        # subproblem objective: W.x + rho/2 ||x - z||^2 (prox against z,
+        # NOT xbar — the PH/APH difference; reference aph.py:841-884)
+        c_eff = b.c.at[:, na].add(st.W - rho * st.z)
+        q_eff = b.qdiag.at[:, na].add(jnp.broadcast_to(rho, st.W.shape))
+        res = self.solver._solve_jit(
+            self.prep, c_eff, q_eff, lb, ub, b.obj_const, st.x, st.y,
+            None, eps)
+
+        m2 = mask[:, None]
+        x = jnp.where(m2, res.x, st.x)
+        y_rows = jnp.where(m2, res.y, st.y)
+        x_na = b.nonants(x)
+        # Update_y (reference aph.py:151-182) for dispatched scenarios
+        y_na = jnp.where(m2, st.W + rho * (x_na - st.z), st.y_na)
+
+        xbar, xsqbar = compute_xbar(b, x_na)
+        ybar = node_average(b, y_na)
+
+        p = b.tree.prob
+        u = x_na - xbar
+        v = ybar
+        pusq = jnp.sum(p * jnp.sum(u * u, axis=1))
+        pvsq = jnp.sum(p * jnp.sum(v * v, axis=1))
+        tau = pusq + pvsq / self.APHgamma
+        phi = jnp.sum(p * jnp.sum((st.z - x_na) * (st.W - y_na), axis=1))
+        theta = jnp.where((tau > 0) & (phi > 0),
+                          self.APHnu * phi / jnp.maximum(tau, 1e-30), 0.0)
+
+        W = st.W + theta * u
+        z = st.z + theta * ybar / self.APHgamma
+
+        pwsq = jnp.sum(p * jnp.sum(W * W, axis=1))
+        pzsq = jnp.sum(p * jnp.sum(z * z, axis=1))
+        conv = (jnp.sqrt(pusq) / jnp.maximum(jnp.sqrt(pwsq), 1e-30)
+                + jnp.sqrt(pvsq) / jnp.maximum(jnp.sqrt(pzsq), 1e-30))
+
+        obj = b.objective(x)
+        return APHState(
+            x=x, y=y_rows, y_na=y_na, W=W, z=z,
+            xbar=xbar, xsqbar=xsqbar, ybar=ybar,
+            obj=obj, dual_obj=res.dual_obj, conv=conv,
+            theta=theta, phi=phi, tau=tau, it=st.it + 1,
+            last_dispatch=jnp.where(mask, st.it + 1, st.last_dispatch))
+
+    # -- driver (reference APH_main, aph.py:820-922) ----------------------
+    def APH_main(self, spcomm=None, finalize=True):
+        if spcomm is not None:
+            self.spcomm = spcomm
+        self.Iter0()   # PHBase Iter0: no-penalty solves, trivial bound
+        st0 = self.state
+        b = self.batch
+        S = b.num_scens
+        # iteration-1 specials (reference aph.py:481-485): z := xbar,
+        # y := 0; W carries Iter0's PH update
+        self.aph_state = APHState(
+            x=st0.x, y=st0.y, y_na=jnp.zeros_like(st0.W), W=st0.W,
+            z=st0.xbar, xbar=st0.xbar, xsqbar=st0.xsqbar,
+            ybar=jnp.zeros_like(st0.W), obj=st0.obj,
+            dual_obj=st0.dual_obj, conv=jnp.asarray(jnp.inf, b.c.dtype),
+            theta=jnp.asarray(0.0, b.c.dtype),
+            phi=jnp.asarray(0.0, b.c.dtype),
+            tau=jnp.asarray(0.0, b.c.dtype),
+            it=jnp.asarray(1, jnp.int32),
+            last_dispatch=jnp.zeros((S,), jnp.int32))
+
+        max_iters = int(self.options.get("PHIterLimit", 100))
+        convthresh = float(self.options.get("convthresh", 1e-4))
+        for k in range(2, max_iters + 2):
+            self.aph_state = self._aph_superstep(
+                self.aph_state, self.rho, self.lb_eff, self.ub_eff,
+                self.solver_eps)
+            # mirror into PHState-compatible fields for spokes/extensions
+            self.state = dataclasses.replace(
+                self.state, x=self.aph_state.x, y=self.aph_state.y,
+                W=self.aph_state.W, xbar=self.aph_state.xbar,
+                xsqbar=self.aph_state.xsqbar, obj=self.aph_state.obj,
+                dual_obj=self.aph_state.dual_obj,
+                conv=self.aph_state.conv, it=self.aph_state.it)
+            self.conv = float(self.aph_state.conv)
+            self._ext("miditer")
+            if k % 10 == 0 or k == 2:
+                global_toc(f"APH iter {k:4d} conv={self.conv:.6e} "
+                           f"theta={float(self.aph_state.theta):.4g} "
+                           f"phi={float(self.aph_state.phi):.4g}")
+            self._ext("enditer")
+            if self.spcomm is not None:
+                self.spcomm.sync()
+                if self.spcomm.is_converged():
+                    global_toc(f"APH terminated by hub at iter {k}")
+                    break
+            if self.conv < convthresh:
+                global_toc(f"APH converged (conv={self.conv:.3e}) "
+                           f"at iter {k}")
+                break
+            self._ext("enditer_after_sync")
+        self._ext("post_everything")
+        if finalize:
+            eobj = self.post_loops()
+            return self.conv, eobj, self.trivial_bound
+        return self.conv, None, self.trivial_bound
+
+    # lowercase alias matching this package's PH.ph_main style
+    def aph_main(self, finalize=True):
+        return self.APH_main(finalize=finalize)
+
+    def root_z(self):
+        """Root-node consensus point z (the APH candidate solution)."""
+        return self.aph_state.z[0]

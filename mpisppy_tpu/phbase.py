@@ -114,7 +114,8 @@ class PHBase(SPOpt):
                  scenario_denouement=None, all_nodenames=None,
                  extensions=None, extension_kwargs=None,
                  rho_setter=None, variable_probability=None,
-                 scenario_creator_kwargs=None, batch=None, mesh=None):
+                 scenario_creator_kwargs=None, batch=None, mesh=None,
+                 prep=None):
         super().__init__(
             options, all_scenario_names,
             scenario_creator=scenario_creator,
@@ -122,7 +123,7 @@ class PHBase(SPOpt):
             all_nodenames=all_nodenames,
             scenario_creator_kwargs=scenario_creator_kwargs,
             variable_probability=variable_probability,
-            batch=batch, mesh=mesh)
+            batch=batch, mesh=mesh, prep=prep)
         self.rho_setter = rho_setter
         self.extobject = None
         if extensions is not None:
@@ -151,16 +152,61 @@ class PHBase(SPOpt):
         self._superstep = jax.jit(self._superstep_impl)
         self.conv = None
 
+        # effective bounds: extensions (Fixer, slamming) pin nonants by
+        # tightening these; the jitted superstep takes them as ARGS so a
+        # fix never triggers recompilation (the reference mutates Pyomo
+        # var.fix() instead, spopt.py:592-740)
+        self.lb_eff = self.batch.lb
+        self.ub_eff = self.batch.ub
+        # dynamic solver tolerance (Gapper analog) as a jnp scalar —
+        # traced, so schedule changes don't recompile
+        self.solver_eps = jnp.asarray(self.solver.eps, self.batch.c.dtype)
+
+        # optional converger (reference phbase.py:726-755 PH_Prep wires
+        # options["ph_converger"]; convergers/converger.py API)
+        self.convobject = None
+        conv_cls = self.options.get("ph_converger")
+        if conv_cls is not None:
+            self.convobject = conv_cls(self)
+
     # -- hook plumbing (reference extensions/extension.py API) ------------
-    def _ext(self, hook):
+    def _ext(self, hook, *args):
         if self.extobject is not None:
-            getattr(self.extobject, hook, lambda: None)()
+            getattr(self.extobject, hook, lambda *a: None)(*args)
+
+    # -- nonant fixing for extensions (reference spopt.py:592-740) --------
+    def fix_nonants(self, mask, values):
+        """Pin nonant slots where mask (S, K) is True to `values` (S, K)
+        by tightening the effective bounds.  Idempotent; unfix_nonants
+        reverses."""
+        b = self.batch
+        na = b.nonant_idx
+        vals = jnp.asarray(values, b.c.dtype)
+        m = jnp.asarray(mask, bool)
+        self.lb_eff = self.lb_eff.at[:, na].set(
+            jnp.where(m, vals, self.lb_eff[:, na]))
+        self.ub_eff = self.ub_eff.at[:, na].set(
+            jnp.where(m, vals, self.ub_eff[:, na]))
+
+    def unfix_nonants(self, mask):
+        """Restore original batch bounds where mask (S, K) is True."""
+        b = self.batch
+        na = b.nonant_idx
+        m = jnp.asarray(mask, bool)
+        self.lb_eff = self.lb_eff.at[:, na].set(
+            jnp.where(m, b.lb[:, na], self.lb_eff[:, na]))
+        self.ub_eff = self.ub_eff.at[:, na].set(
+            jnp.where(m, b.ub[:, na], self.ub_eff[:, na]))
+
+    def count_fixed(self):
+        na = self.batch.nonant_idx
+        return int(jnp.sum(self.lb_eff[:, na] == self.ub_eff[:, na]))
 
     # -- Iter0 (reference phbase.py:758-872) ------------------------------
     def Iter0(self):
         self._ext("pre_iter0")
         global_toc("Iter0: no-penalty solves")
-        res = self.solve_loop(warm=False,
+        res = self.solve_loop(lb=self.lb_eff, ub=self.ub_eff, warm=False,
                               dtiming=self.options.get("display_timing"))
         feas = self.feas_prob(res)
         if feas < 1.0 - 1e-6:
@@ -183,13 +229,16 @@ class PHBase(SPOpt):
         return self.trivial_bound
 
     # -- one PH iteration, fully fused ------------------------------------
-    def _superstep_impl(self, state: PHState, rho, W_on, prox_on):
+    def _superstep_impl(self, state: PHState, rho, W_on, prox_on,
+                        lb=None, ub=None, eps=None):
         b = self.batch
+        lb = b.lb if lb is None else lb
+        ub = b.ub if ub is None else ub
         c_eff, q_eff = ph_objective_arrays(
             b, state.W, rho, state.xbar, W_on=W_on, prox_on=prox_on)
         res = self.solver._solve_jit(
-            self.prep, c_eff, q_eff, b.lb, b.ub, b.obj_const,
-            state.x, state.y)
+            self.prep, c_eff, q_eff, lb, ub, b.obj_const,
+            state.x, state.y, None, eps)
         x_na = b.nonants(res.x)
         xbar, xsqbar = compute_xbar(b, x_na)
         W = update_W(state.W, rho, x_na, xbar)
@@ -201,8 +250,11 @@ class PHBase(SPOpt):
             obj=obj, dual_obj=res.dual_obj, conv=conv, it=state.it + 1)
 
     def ph_iteration(self):
+        self._ext("pre_solve_loop")
         self.state = self._superstep(
-            self.state, self.rho, self.W_on, self.prox_on)
+            self.state, self.rho, self.W_on, self.prox_on,
+            self.lb_eff, self.ub_eff, self.solver_eps)
+        self._ext("post_solve_loop")
         self.conv = float(self.state.conv)
         return self.conv
 
@@ -224,6 +276,10 @@ class PHBase(SPOpt):
                 if self.spcomm.is_converged():
                     global_toc(f"PH terminated by hub at iter {k}")
                     break
+            if self.convobject is not None and self.convobject.is_converged():
+                global_toc(f"PH terminated by converger "
+                           f"{type(self.convobject).__name__} at iter {k}")
+                break
             if conv < convthresh:
                 global_toc(f"PH converged (conv={conv:.3e} < "
                            f"{convthresh}) at iter {k}")

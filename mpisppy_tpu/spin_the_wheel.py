@@ -61,6 +61,13 @@ class WheelSpinner:
             # (reference :106-108), minus the duplicate model build
             kw.setdefault("batch", hub_opt.batch)
             kw.setdefault("mesh", hub_opt.mesh)
+            # share the hub's PreparedBatch too (Ruiz scaling + ||A||):
+            # identical batch => identical prep, as long as the spoke's
+            # opt class uses the same column-scaling mode
+            if (kw.get("batch") is hub_opt.batch
+                    and sd["opt_class"]._shared_cols
+                    == hd["opt_class"]._shared_cols):
+                kw.setdefault("prep", hub_opt.prep)
             sp_opt = sd["opt_class"](**kw)
             spoke = sd["spoke_class"](
                 sp_opt, options=sd.get("spoke_kwargs", {}).get("options"))

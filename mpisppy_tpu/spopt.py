@@ -30,7 +30,7 @@ class SPOpt(SPBase):
     # (consensus/EF solves) set this so the batch is prepared once
     _shared_cols = False
 
-    def __init__(self, *args, **kwargs):
+    def __init__(self, *args, prep=None, **kwargs):
         super().__init__(*args, **kwargs)
         o = self.options
         self.solver = PDHGSolver(
@@ -39,10 +39,16 @@ class SPOpt(SPBase):
             check_every=int(o.get("pdhg_check_every", 40)),
             restart_every=int(o.get("pdhg_restart_every", 4)),
         )
-        global_toc("Preparing batch (Ruiz scaling + ||A|| estimate)")
-        self.prep = prepare_batch(
-            self.batch.A, self.batch.row_lo, self.batch.row_hi,
-            shared_cols=self._shared_cols)
+        if prep is not None:
+            # shared PreparedBatch from a sibling cylinder over the SAME
+            # batch (WheelSpinner passes the hub's — Ruiz scaling and the
+            # norm estimate depend only on (A, row bounds, _shared_cols))
+            self.prep = prep
+        else:
+            global_toc("Preparing batch (Ruiz scaling + ||A|| estimate)")
+            self.prep = prepare_batch(
+                self.batch.A, self.batch.row_lo, self.batch.row_hi,
+                shared_cols=self._shared_cols)
         # warm-start caches (analog of persistent-solver state,
         # reference spopt.py:877 set_instance_retry — license logic gone)
         self._x_warm = None

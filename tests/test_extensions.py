@@ -1,0 +1,167 @@
+"""Extensions + convergers tests (reference analog:
+mpisppy/tests/test_ef_ph.py extension cases + convergers usage).
+
+Uses small farmer instances; integer-fixing paths use the integer
+farmer variant (use_integer=True marks DevotedAcreage integral).
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.convergers.fracintsnotconv import FractionalConverger
+from mpisppy_tpu.convergers.norm_rho_converger import NormRhoConverger
+from mpisppy_tpu.convergers.primal_dual_converger import PrimalDualConverger
+from mpisppy_tpu.extensions import Extension, MultiExtension
+from mpisppy_tpu.extensions.avgminmaxer import MinMaxAvg
+from mpisppy_tpu.extensions.fixer import Fixer
+from mpisppy_tpu.extensions.mipgapper import Gapper
+from mpisppy_tpu.extensions.mult_rho_updater import MultRhoUpdater
+from mpisppy_tpu.extensions.norm_rho_updater import NormRhoUpdater
+from mpisppy_tpu.extensions.wtracker_extension import Wtracker_extension
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.opt.ph import PH
+
+
+def make_ph(extensions=None, ext_kwargs=None, num_scens=3, opts_extra=None,
+            use_integer=False):
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": 10, "convthresh": 1e-6,
+            "pdhg_eps": 1e-6, "pdhg_max_iters": 4000}
+    opts.update(opts_extra or {})
+    b = farmer.build_batch(num_scens, use_integer=use_integer)
+    return PH(opts, [f"scen{i}" for i in range(num_scens)], batch=b,
+              extensions=extensions, extension_kwargs=ext_kwargs)
+
+
+class HookRecorder(Extension):
+    calls = []
+
+    def __init__(self, ph):
+        super().__init__(ph)
+        HookRecorder.calls = []
+
+    def pre_iter0(self):
+        HookRecorder.calls.append("pre_iter0")
+
+    def post_iter0(self):
+        HookRecorder.calls.append("post_iter0")
+
+    def miditer(self):
+        HookRecorder.calls.append("miditer")
+
+    def enditer(self):
+        HookRecorder.calls.append("enditer")
+
+    def post_everything(self):
+        HookRecorder.calls.append("post_everything")
+
+
+def test_hooks_fire_in_order():
+    ph = make_ph(extensions=HookRecorder,
+                 opts_extra={"PHIterLimit": 2, "convthresh": 0.0})
+    ph.ph_main()
+    calls = HookRecorder.calls
+    assert calls[0] == "pre_iter0"
+    assert calls[1] == "post_iter0"
+    assert "miditer" in calls and "enditer" in calls
+    assert calls[-1] == "post_everything"
+    assert calls.index("post_iter0") < calls.index("miditer")
+
+
+def test_multi_extension_fans_out():
+    ph = make_ph(
+        extensions=MultiExtension,
+        ext_kwargs={"ext_classes": [HookRecorder, MinMaxAvg]},
+        opts_extra={"PHIterLimit": 1, "convthresh": 0.0})
+    ph.ph_main()
+    assert "post_everything" in HookRecorder.calls
+
+
+def test_gapper_sets_eps():
+    ph = make_ph(
+        extensions=Gapper,
+        opts_extra={"PHIterLimit": 3, "convthresh": 0.0,
+                    "gapperoptions": {"mipgapdict": {0: 1e-3, 2: 1e-5}}})
+    ph.ph_main()
+    assert float(ph.solver_eps) == pytest.approx(1e-5)
+
+
+def test_fixer_fixes_integers():
+    # integer farmer: DevotedAcreage integral; with the known optimum
+    # (170, 80, 250) integral anyway, PH agrees quickly and the Fixer
+    # should pin slots after nb consecutive ripe iterations
+    ph = make_ph(
+        extensions=Fixer, use_integer=True,
+        opts_extra={"PHIterLimit": 12, "convthresh": 0.0,
+                    "defaultPHrho": 2.0,
+                    "fixeroptions": {"boundtol": 0.5, "nb": 2,
+                                     "verbose": True}})
+    ph.ph_main()
+    assert ph.count_fixed() > 0
+    # fixed slots must carry equal lb/ub at integral values
+    na = np.asarray(ph.batch.nonant_idx)
+    lb = np.asarray(ph.lb_eff)[:, na]
+    ub = np.asarray(ph.ub_eff)[:, na]
+    fixed = lb == ub
+    assert np.allclose(lb[fixed], np.round(lb[fixed]))
+
+
+def test_norm_rho_updater_changes_rho():
+    ph = make_ph(
+        extensions=NormRhoUpdater,
+        opts_extra={"PHIterLimit": 6, "convthresh": 0.0,
+                    "defaultPHrho": 1e-4,   # absurdly low -> primal dominates
+                    "norm_rho_options": {"ratio": 2.0, "step": 2.0}})
+    rho0 = float(np.mean(np.asarray(ph.rho)))
+    ph.ph_main()
+    assert float(np.mean(np.asarray(ph.rho))) > rho0
+
+
+def test_mult_rho_updater():
+    ph = make_ph(
+        extensions=MultRhoUpdater,
+        opts_extra={"PHIterLimit": 6, "convthresh": 0.0,
+                    "defaultPHrho": 1e-5,
+                    "mult_rho_options": {"convergence_tolerance": 1e-12,
+                                         "rho_multiplier": 3.0}})
+    rho0 = float(np.mean(np.asarray(ph.rho)))
+    ph.ph_main()
+    assert float(np.mean(np.asarray(ph.rho))) >= rho0
+
+
+def test_wtracker_runs(capsys):
+    ph = make_ph(
+        extensions=Wtracker_extension,
+        opts_extra={"PHIterLimit": 4, "convthresh": 0.0,
+                    "wtracker_options": {"wlen": 3}})
+    ph.ph_main()
+    out = capsys.readouterr().out
+    assert "WTracker" in out
+
+
+def test_primal_dual_converger_stops():
+    ph = make_ph(opts_extra={
+        "PHIterLimit": 100, "convthresh": 0.0,
+        "ph_converger": PrimalDualConverger,
+        "primal_dual_converger_options": {"tol": 1e-2}})
+    ph.ph_main()
+    assert int(ph.state.it) < 100
+    assert ph.convobject.convergence_value < 1e-2
+
+
+def test_norm_rho_converger_stops():
+    ph = make_ph(opts_extra={
+        "PHIterLimit": 100, "convthresh": 0.0,
+        "ph_converger": NormRhoConverger,
+        "norm_rho_converger_tol": 1e-2})
+    ph.ph_main()
+    assert int(ph.state.it) < 100
+
+
+def test_fractional_converger_integer_farmer():
+    ph = make_ph(use_integer=True, opts_extra={
+        "PHIterLimit": 60, "convthresh": 0.0,
+        "defaultPHrho": 2.0,
+        "ph_converger": FractionalConverger,
+        "fracintsnotconv_tol": 0.5})
+    ph.ph_main()
+    assert ph.convobject.convergence_value is not None
