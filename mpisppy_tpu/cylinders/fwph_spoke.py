@@ -1,0 +1,39 @@
+"""FrankWolfeOuterBound spoke (reference:
+mpisppy/cylinders/fwph_spoke.py:5-33).
+
+Wraps an FWPH optimizer as an outer-bound cylinder: each step runs one
+FWPH outer pass and posts the newest dual bound.  Consumes nothing from
+the hub (the reference spoke likewise runs fwph_main independently).
+"""
+
+from __future__ import annotations
+
+from .spoke import ConvergerSpokeType, _BoundSpoke
+
+
+class FrankWolfeOuterBound(_BoundSpoke):
+    converger_spoke_types = (ConvergerSpokeType.OUTER_BOUND,)
+    converger_spoke_char = "F"
+
+    def receive_length(self):
+        return 1   # hub pushes nothing this spoke consumes
+
+    def main(self):
+        """Threaded-mode loop WITHOUT the serial-number gate of the
+        base class: this spoke consumes nothing from the hub (its
+        write_id never advances), it just produces bounds until
+        killed — like the reference's independent fwph_main cylinder."""
+        while not self.got_kill_signal():
+            self.step()
+
+    def step(self):
+        opt = self.opt
+        if not getattr(opt, "_prepped", False):
+            opt.fw_prep()
+        opt.fwph_iteration()
+        if opt.dual_bound is not None:
+            self.update_if_improving(opt.dual_bound)
+        return True
+
+    def finalize(self):
+        return self.bound

@@ -240,3 +240,52 @@ class APHHub(PHHub):
 
     def main(self):
         return self.opt.APH_main(spcomm=self, finalize=False)
+
+
+class LShapedHub(Hub):
+    """L-shaped as hub (reference hub.py:600-689): sends nonant
+    candidates (no W spokes), receives bounds, gap termination."""
+
+    def setup_hub(self):
+        self.wire_spokes()
+        if self.w_idx:
+            raise RuntimeError(
+                "LShapedHub cannot feed W spokes (reference hub.py:628)")
+
+    def sync(self, send_nonants=True):
+        if send_nonants:
+            self.send_nonants()
+        if self.drive_spokes_inline:
+            for sp in self.spokes:
+                sp.step()
+        self.receive_outerbounds()
+        self.receive_innerbounds()
+
+    def is_converged(self):
+        # the hub's own loop provides both bounds; spokes may improve
+        # the inner one
+        ob = self.opt.outer_bound
+        if np.isfinite(ob):
+            self.OuterBoundUpdate(ob, char="B")
+        ib = self.opt.inner_bound
+        if np.isfinite(ib):
+            self.InnerBoundUpdate(ib, char="B")
+        self.screen_trace()
+        return self.determine_termination()
+
+    def current_iteration(self):
+        return self.opt.iter
+
+    def main(self):
+        return self.opt.lshaped_algorithm()
+
+    def send_nonants(self):
+        """Push the current candidate x̂, replicated per scenario so
+        nonant-spokes see the usual (S*K,) layout."""
+        xhat = getattr(self.opt, "best_xhat", None)
+        if xhat is None:
+            return
+        b = self.opt.batch
+        flat = np.tile(np.asarray(xhat), (b.num_scens, 1)).reshape(-1)
+        for i in self.nonant_idx_set:
+            self.pairs[i].to_spoke.write(flat)

@@ -1,0 +1,29 @@
+"""XhatLShapedInnerBound — evaluate the L-shaped hub's candidate x̂
+(reference: mpisppy/cylinders/lshaped_bounder.py:15).
+
+Fixes the received nonants and does one batched solve; reports E[obj]
+as an inner bound when feasible.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spoke import ConvergerSpokeType, InnerBoundNonantSpoke
+
+
+class XhatLShapedInnerBound(InnerBoundNonantSpoke):
+    converger_spoke_char = "X"
+
+    def step(self):
+        nonants, is_new = self.fresh_nonants()
+        if self._killed or not is_new:
+            return False
+        xhat = np.asarray(nonants)[0]   # hub replicates x̂ per scenario
+        eobj, feasible = self.opt.evaluate_xhat(xhat)
+        if feasible:
+            self.update_if_improving(eobj, solution=xhat)
+        return True
+
+    def finalize(self):
+        return self.bound

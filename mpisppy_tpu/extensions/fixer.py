@@ -16,7 +16,14 @@ fixer_tol / id_fix_list_fct indirection with flat knobs):
     nb           : consecutive-iteration count to fix (default 3)
     fix_integers : fix integer-marked slots by rounding xbar (default True)
     fix_continuous : also fix continuous slots to xbar (default False)
-    unfix_on_drift : unfix slots whose xbar later drifts (default False)
+    unfix_on_drift : unfix slots under dual pressure (default False).
+                     Once a slot is pinned (lb=ub) neither its xbar nor
+                     its W can move, so the live release signal is the
+                     REDUCED COST of the pinned slot in the PH
+                     subproblem, r = c_eff + q_eff*x + A'y — the
+                     objective pressure against the pin.  Released when
+                     |r| > drift_W_factor * (1 + |c|) at the slot.
+    drift_W_factor : see above (default 10.0)
     verbose
 """
 
@@ -37,6 +44,7 @@ class Fixer(Extension):
         self.fix_integers = bool(o.get("fix_integers", True))
         self.fix_continuous = bool(o.get("fix_continuous", False))
         self.unfix_on_drift = bool(o.get("unfix_on_drift", False))
+        self.drift_W_factor = float(o.get("drift_W_factor", 10.0))
         self.verbose = bool(o.get("verbose", False))
         b = ph.batch
         S, K = b.num_scens, b.num_nonants
@@ -88,15 +96,34 @@ class Fixer(Extension):
                 global_toc(f"Fixer: fixed {int(newly.sum())} new slots "
                            f"({int(self._fixed.sum())} total)")
         elif self.unfix_on_drift and self._fixed.any():
-            xbar = np.asarray(self.opt.state.xbar)
+            r_na = self._pinned_reduced_costs()
+            c_na = np.abs(np.asarray(self.opt.batch.c))[
+                :, np.asarray(self.opt.batch.nonant_idx)]
             drift = self._fixed & (
-                np.abs(xbar - self._fixed_vals) > 10 * self.boundtol)
+                np.abs(r_na) > self.drift_W_factor * (1.0 + c_na))
             if drift.any():
                 self._fixed &= ~drift
                 self._count = np.where(drift, 0, self._count)
                 self.opt.unfix_nonants(drift)
                 if self.verbose:
                     global_toc(f"Fixer: unfixed {int(drift.sum())} slots")
+
+    def _pinned_reduced_costs(self):
+        """Reduced cost of each nonant slot in the PH subproblem at the
+        current iterate: r = c_eff + q_eff*x + A'y, restricted to nonant
+        columns.  At a pinned slot this is the objective pressure the
+        pin resists (KKT multiplier of lb=ub)."""
+        import jax.numpy as jnp
+        opt = self.opt
+        b = opt.batch
+        st = opt.state
+        na = b.nonant_idx
+        rho = opt.rho
+        c_eff = b.c.at[:, na].add(st.W - rho * st.xbar)
+        q_eff = b.qdiag.at[:, na].add(jnp.broadcast_to(rho, st.W.shape))
+        aty = jnp.einsum("smn,sm->sn", b.A, st.y)
+        r = c_eff + q_eff * st.x + aty
+        return np.asarray(r[:, na])
 
     def post_everything(self):
         global_toc(f"Fixer: {int(self._fixed.sum())} slots fixed at end "

@@ -1,0 +1,1 @@
+from .fwph import FWPH  # noqa: F401

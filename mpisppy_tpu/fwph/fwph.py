@@ -1,0 +1,201 @@
+"""FWPH — Frank-Wolfe Progressive Hedging (reference:
+mpisppy/fwph/fwph.py, 1045 LoC; Boland, Christiansen, Dandurand,
+Eberhard, Linderoth, Luedtke, Oliveira 2018).
+
+The reference keeps, per scenario, a growing convex-hull ("simplicial
+decomposition") approximation: an inner SDM loop alternates a MIP solve
+(new vertex/column) with a QP solve over the hull (fwph.py:210-303
+`SDM`, `_add_QP_column:305`), producing a SEQUENCE of valid dual
+(outer) bounds alongside the PH updates.
+
+TPU-native restructuring:
+
+  * The column bank is a dense (S, T, N) tensor with an active mask —
+    fixed capacity T keeps shapes static; when full, the column with
+    the smallest hull weight is overwritten (least-used eviction).
+  * The **vertex solve** is the batched PDHG LP kernel with the
+    linearized objective (for integer problems this is the LP
+    relaxation — SURVEY.md §2.9's MIP stance).
+  * The **hull QP** min_{lam in simplex} f_s(V lam) + W.(V lam)_na
+    + rho/2 ||(V lam)_na - xbar||^2 has a dense Hessian in lam, which
+    the diagonal-Q kernel can't express — so it is solved in LIFTED
+    (x, lam) space:  x - V lam = 0 rows + one simplex row, diagonal
+    prox on x.  One batched solve for all scenarios.
+  * The first vertex solve of each outer pass uses the PURE Lagrangian
+    objective c + W (no prox linearization), so its dual objective is
+    exactly the Lagrangian dual bound — the reference's per-iteration
+    outer bound (fwph.py:142-208) for free.
+
+API mirror: FWPH(options, ...).fwph_main() -> (conv, Eobj, dual_bound).
+Options: FW_iter_limit (SDM rounds/outer pass, default 2), column_bank
+(capacity T, default 16), plus PH options.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import global_toc
+from ..ops.pdhg import PDHGSolver, prepare_batch
+from ..phbase import PHBase, compute_xbar, convergence_metric, update_W
+
+
+class FWPH(PHBase):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        o = self.options
+        self.fw_iter_limit = int(o.get("FW_iter_limit", 2))
+        self.T = int(o.get("column_bank", 16))
+        b = self.batch
+        S, N = b.num_scens, b.num_vars
+        # column bank: V (S, T, N), active mask, hull weights
+        self._V = np.zeros((S, self.T, N))
+        self._active = np.zeros((S, self.T), bool)
+        self._lam = np.zeros((S, self.T))
+        self._qp_solver = PDHGSolver(
+            max_iters=int(o.get("pdhg_max_iters", 20000)),
+            eps=float(o.get("pdhg_eps", 1e-6)))
+        self.dual_bound = None         # best (max for min-problems) so far
+        self._dual_bounds = []         # sequence, one per outer pass
+
+    # -- column management -------------------------------------------------
+    def _add_columns(self, x_new):
+        """Insert (S, N) vertices; evict the least-used column if full."""
+        x_new = np.asarray(x_new)
+        for s in range(x_new.shape[0]):
+            free = np.where(~self._active[s])[0]
+            if free.size:
+                t = free[0]
+            else:
+                t = int(np.argmin(self._lam[s]))
+            self._V[s, t] = x_new[s]
+            self._active[s, t] = True
+            self._lam[s, t] = 0.0   # weight assigned by the next hull QP
+
+    # -- hull QP in lifted (x, lam) space ---------------------------------
+    def _hull_qp(self, W, xbar):
+        """min c.x + W.x_na + rho/2||x_na - xbar||^2
+        s.t. x = V lam, sum lam = 1, lam >= 0 (active cols only).
+        Returns (x (S,N), lam (S,T), obj (S,))."""
+        b = self.batch
+        S, N, T = b.num_scens, b.num_vars, self.T
+        K = b.num_nonants
+        na = np.asarray(b.nonant_idx)
+
+        # variables [x (N) | lam (T)]; rows: N coupling + 1 simplex
+        M = N + 1
+        A = np.zeros((S, M, N + T))
+        A[:, :N, :N] = np.eye(N)[None]
+        A[:, :N, N:] = -np.transpose(self._V, (0, 2, 1))
+        A[:, N, N:] = self._active.astype(float)
+        row_lo = np.zeros((S, M))
+        row_hi = np.zeros((S, M))
+        row_lo[:, N] = 1.0
+        row_hi[:, N] = 1.0
+
+        lb = np.full((S, N + T), -np.inf)
+        ub = np.full((S, N + T), np.inf)
+        lb[:, :N] = np.asarray(b.lb)
+        ub[:, :N] = np.asarray(b.ub)
+        lb[:, N:] = 0.0
+        ub[:, N:] = np.where(self._active, 1.0, 0.0)
+
+        rho = np.asarray(self.rho)
+        c = np.zeros((S, N + T))
+        c[:, :N] = np.asarray(b.c)
+        c[:, na] += np.asarray(W) - rho * np.asarray(xbar)
+        q = np.zeros((S, N + T))
+        q[:, na] = rho
+
+        prep = prepare_batch(jnp.asarray(A), jnp.asarray(row_lo),
+                             jnp.asarray(row_hi))
+        res = self._qp_solver.solve(
+            prep, jnp.asarray(c), jnp.asarray(q),
+            jnp.asarray(lb), jnp.asarray(ub))
+        # np.array (copy): jax arrays viewed via asarray are read-only,
+        # and _lam must stay writable for the eviction bookkeeping
+        x = np.array(res.x[:, :N])
+        lam = np.array(res.x[:, N:])
+        return x, lam
+
+    # -- lifecycle pieces (spoke-steppable) -------------------------------
+    def fw_prep(self):
+        """Iter0 + seed the column banks with the wait-and-see vertices
+        (reference fwph.py:142-160 initialization)."""
+        self.Iter0()
+        self._add_columns(np.asarray(self.state.x))
+        self._prepped = True
+
+    def fwph_iteration(self):
+        """One outer FWPH pass: SDM inner loop + PH updates.  Returns
+        the convergence metric (reference fwph.py:161-208 loop body)."""
+        b = self.batch
+        na = b.nonant_idx
+        st = self.state
+        W, xbar = st.W, st.xbar
+        x_qp = np.asarray(st.x)
+
+        for t in range(self.fw_iter_limit):
+            if t == 0:
+                # pure Lagrangian objective -> valid dual bound
+                c_eff = b.c.at[:, na].add(W)
+                res = self.solver.solve(
+                    self.prep, c_eff, b.qdiag, self.lb_eff,
+                    self.ub_eff, obj_const=b.obj_const,
+                    x0=st.x, y0=st.y)
+                db = float(self.Ebound(res.dual_obj))
+                self._dual_bounds.append(db)
+                if self.dual_bound is None or db > self.dual_bound:
+                    self.dual_bound = db
+            else:
+                # linearize the prox QP at the current hull point
+                x_na = b.nonants(jnp.asarray(x_qp))
+                c_eff = b.c.at[:, na].add(W + self.rho * (x_na - xbar))
+                res = self.solver.solve(
+                    self.prep, c_eff, b.qdiag, self.lb_eff,
+                    self.ub_eff, obj_const=b.obj_const)
+            self._add_columns(np.asarray(res.x))
+            x_qp, lam = self._hull_qp(W, xbar)
+            self._lam = lam
+
+        # PH updates from the hull point
+        x_na = b.nonants(jnp.asarray(x_qp))
+        xbar, xsqbar = compute_xbar(b, x_na)
+        W = update_W(W, self.rho, x_na, xbar)
+        conv = float(convergence_metric(b, x_na, xbar))
+        obj = b.objective(jnp.asarray(x_qp))
+        self.state = self.state.__class__(
+            x=jnp.asarray(x_qp), y=st.y, W=W, xbar=xbar,
+            xsqbar=xsqbar, obj=obj, dual_obj=st.dual_obj,
+            conv=jnp.asarray(conv), it=st.it + 1)
+        self.conv = conv
+        return conv
+
+    # -- main loop (reference fwph.py:142-208) ----------------------------
+    def fwph_main(self, finalize=True):
+        if not getattr(self, "_prepped", False):
+            self.fw_prep()
+        max_iters = int(self.options.get("PHIterLimit", 50))
+        convthresh = float(self.options.get("convthresh", 1e-4))
+        conv = float("inf")
+        for k in range(1, max_iters + 1):
+            conv = self.fwph_iteration()
+            self._ext("miditer")
+            if k % 5 == 0 or k == 1:
+                global_toc(f"FWPH iter {k:3d} conv={conv:.4e} "
+                           f"dual_bound={self.dual_bound:.6g}")
+            self._ext("enditer")
+            if self.spcomm is not None:
+                self.spcomm.sync()
+                if self.spcomm.is_converged():
+                    break
+            if conv < convthresh:
+                global_toc(f"FWPH converged at iter {k}")
+                break
+        self._ext("post_everything")
+        if finalize:
+            eobj = float(self.Eobjective(self.state.obj))
+            return conv, eobj, self.dual_bound
+        return conv, None, self.dual_bound

@@ -1,0 +1,250 @@
+"""L-shaped (Benders) method (reference: mpisppy/opt/lshaped.py, 776 LoC).
+
+The reference builds a root problem on rank 0 with per-scenario `eta`
+epigraph variables (lshaped.py:139-366), strips first-stage constraints
+into it (:380-506), and loops: rank0 root solve -> Bcast x -> all ranks
+generate cuts through pyomo.contrib.benders -> add cuts (:590-679).
+
+TPU-native restructuring (SURVEY.md §2.9: "duals come free from
+first-order solvers"):
+
+  * A **subproblem** is the scenario LP with nonant slots pinned to
+    x̂ via bounds (spopt.fixed_nonant_bounds) — the whole scenario set
+    solves as ONE batched PDHG call, and each pinned slot's reduced
+    cost  r_j = c_j + (A'y)_j  IS the cut gradient dq_s/dx̂_j.
+  * The **root** is a small LP over [x (K,), eta (S,)] with the
+    first-stage rows (rows of A whose support is inside the nonant
+    columns) plus a FIXED-CAPACITY cut buffer — rows activate as cuts
+    arrive, shapes never change, so root solves hit one compiled
+    kernel.
+  * eta lower bounds come from the wait-and-see duals of the unpinned
+    iter-0 solve (valid: q_s(x) >= min_x q_s(x)), replacing the
+    reference's "valid_eta_lb" option (lshaped.py:155-170).
+
+Cuts are the multi-cut family (one eta per scenario, matching the
+reference's per-scenario eta); `single_cut=True` aggregates them
+(the reference's non-multi mode).
+
+Two-stage only, like the reference (lshaped.py asserts two stages).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import global_toc
+from ..ops.pdhg import PDHGSolver, prepare_batch
+from ..spopt import SPOpt
+
+
+class LShapedMethod(SPOpt):
+    def __init__(self, options, all_scenario_names, **kwargs):
+        super().__init__(options, all_scenario_names, **kwargs)
+        if self.batch.tree.num_nodes > 2:  # ROOT (+ possibly pad node)
+            # pad scenarios add one dummy node; real multistage has more
+            if int(np.asarray(self.batch.tree.node_of).max()) > 0 and \
+               np.any(np.asarray(self.batch.tree.node_of)
+                      [: self.n_real_scens] > 0):
+                raise RuntimeError(
+                    "LShapedMethod is two-stage only (so is the "
+                    "reference, opt/lshaped.py)")
+        o = self.options
+        self.max_iter = int(o.get("max_iter", 50))
+        self.tol = float(o.get("tol", 1e-6))
+        self.single_cut = bool(o.get("single_cut", False))
+        self.verbose = bool(o.get("verbose", False))
+        self.root_eps = float(o.get("root_eps", o.get("pdhg_eps", 1e-7)))
+
+        self._build_root_skeleton()
+        self.outer_bound = -np.inf if self.is_minimizing else np.inf
+        self.inner_bound = np.inf if self.is_minimizing else -np.inf
+        self.best_xhat = None
+        self.iter = 0
+        self.spcomm = None
+
+    # -- root construction -------------------------------------------------
+    def _build_root_skeleton(self):
+        b = self.batch
+        K = b.num_nonants
+        S = self.n_real_scens
+        na = np.asarray(b.nonant_idx)
+        self.n_eta = 1 if self.single_cut else S
+
+        # first-stage rows: support entirely inside nonant columns
+        # (the reference's "strip first-stage constraints",
+        # lshaped.py:380-506, done structurally on the lowered arrays)
+        A0 = np.asarray(b.A[0])
+        lo0 = np.asarray(b.row_lo[0])
+        hi0 = np.asarray(b.row_hi[0])
+        nz = np.abs(A0) > 0
+        mask_cols = np.zeros(b.num_vars, bool)
+        mask_cols[na] = True
+        fs_rows = np.where(
+            (nz.any(axis=1)) & (~nz[:, ~mask_cols].any(axis=1)))[0]
+        self._fs_rows = fs_rows
+
+        cuts_per_round = self.n_eta
+        self.max_cuts = cuts_per_round * (self.max_iter + 1)
+        M_root = len(fs_rows) + self.max_cuts
+        N_root = K + self.n_eta
+
+        A = np.zeros((1, M_root, N_root))
+        row_lo = np.full((1, M_root), -np.inf)
+        row_hi = np.full((1, M_root), np.inf)
+        A[0, : len(fs_rows), :K] = A0[np.ix_(fs_rows, na)]
+        row_lo[0, : len(fs_rows)] = lo0[fs_rows]
+        row_hi[0, : len(fs_rows)] = hi0[fs_rows]
+        # cut rows start free (inactive): row_lo = -inf
+
+        # objective: min sum_s p_s eta_s (subproblem q includes the
+        # first-stage cost because pinned slots keep their c terms)
+        c = np.zeros((1, N_root))
+        if self.single_cut:
+            c[0, K] = 1.0
+        else:
+            c[0, K:] = np.asarray(b.prob)[:S]
+        # x bounds from the batch; eta bounds filled after iter0
+        lb = np.full((1, N_root), -np.inf)
+        ub = np.full((1, N_root), np.inf)
+        lb[0, :K] = np.asarray(b.lb[0])[na]
+        ub[0, :K] = np.asarray(b.ub[0])[na]
+
+        self._root = {
+            "A": A, "row_lo": row_lo, "row_hi": row_hi,
+            "c": c, "lb": lb, "ub": ub,
+            "n_cuts": 0, "K": K, "S": S,
+        }
+        self._root_solver = PDHGSolver(
+            max_iters=int(self.options.get("root_max_iters", 50000)),
+            eps=self.root_eps)
+        self._root_warm = None
+
+    def _root_solve(self):
+        r = self._root
+        prep = prepare_batch(jnp.asarray(r["A"]),
+                             jnp.asarray(r["row_lo"]),
+                             jnp.asarray(r["row_hi"]))
+        x0 = y0 = None
+        if self._root_warm is not None:
+            x0, y0 = self._root_warm
+        res = self._root_solver.solve(
+            prep, jnp.asarray(r["c"]), jnp.zeros_like(jnp.asarray(r["c"])),
+            jnp.asarray(r["lb"]), jnp.asarray(r["ub"]), x0=x0, y0=y0)
+        self._root_warm = (res.x, res.y)
+        xhat = np.asarray(res.x[0, : r["K"]])
+        root_obj = float(res.obj[0])
+        return xhat, root_obj
+
+    def _add_cuts(self, xhat, q, grad, only=None):
+        """q: (S,) subproblem values; grad: (S, K) cut gradients.
+        Cut: eta_s >= q_s + grad_s.(x - xhat)  ->
+             eta_s - grad_s.x >= q_s - grad_s.xhat
+        `only`: optional (S,) bool — add cuts just for those scenarios
+        (used when some subproblems failed to converge)."""
+        r = self._root
+        K, S = r["K"], r["S"]
+        if self.single_cut:
+            p = np.asarray(self.batch.prob)[:S]
+            q = np.array([np.dot(p, q)])
+            grad = (p[:, None] * grad).sum(axis=0, keepdims=True)
+            only = None
+        for j in range(q.shape[0]):
+            if only is not None and not only[j]:
+                continue
+            i = len(self._fs_rows) + r["n_cuts"]
+            if r["n_cuts"] >= self.max_cuts:
+                global_toc("L-shaped: cut buffer full; dropping cut")
+                return
+            r["A"][0, i, :K] = -grad[j]
+            r["A"][0, i, K + j] = 1.0
+            r["row_lo"][0, i] = q[j] - float(grad[j] @ xhat)
+            r["n_cuts"] += 1
+
+    # -- main loop (reference lshaped.py:508-679 lshaped_algorithm) --------
+    def lshaped_algorithm(self):
+        b = self.batch
+        S = self.n_real_scens
+        na = b.nonant_idx
+
+        # iter0: unpinned wait-and-see solves -> eta lower bounds + x0
+        global_toc("L-shaped iter0: wait-and-see solves")
+        res = self.solve_loop(warm=False)
+        ws_dual = np.asarray(res.dual_obj)[:S]
+        K = b.num_nonants
+        r = self._root
+        if self.single_cut:
+            p = np.asarray(b.prob)[:S]
+            r["lb"][0, K] = float(p @ ws_dual) - abs(float(p @ ws_dual)) - 1.0
+        else:
+            r["lb"][0, K:] = ws_dual - np.abs(ws_dual) * 1e-6 - 1.0
+        # initial candidate: probability-weighted average of the
+        # wait-and-see nonants (what PH iter0 would call xbar)
+        p = np.asarray(b.prob)[:, None]
+        x_na = np.asarray(b.nonants(res.x))
+        xhat = (p * x_na).sum(axis=0) / p.sum()
+
+        for k in range(1, self.max_iter + 1):
+            self.iter = k
+            # subproblems: pin nonants to xhat, batched solve
+            lb, ub = self.fixed_nonant_bounds(jnp.asarray(xhat))
+            sub = self.solve_loop(lb=lb, ub=ub, warm=True)
+            q = np.asarray(sub.obj)[:S]
+            # cut gradient = reduced cost at pinned slots
+            grad_full = np.asarray(self._reduced_costs(sub))[:S]
+            grad = grad_full[:, np.asarray(na)]
+
+            # trust nothing from a non-converged/infeasible subproblem
+            # (models without relatively complete recourse; the
+            # reference classifies solver status, spopt.py:175-194)
+            feas_tol = 10 * self.solver.eps
+            scen_ok = np.asarray(sub.pres)[:S] < feas_tol
+            all_ok = bool(scen_ok.all())
+
+            if all_ok:
+                ib = float(np.asarray(b.prob)[:S] @ q)
+                if self._ib_better(ib, self.inner_bound):
+                    self.inner_bound = ib
+                    self.best_xhat = xhat.copy()
+                self._add_cuts(xhat, q, grad)
+            else:
+                bad = np.where(~scen_ok)[0]
+                global_toc(f"L-shaped iter {k}: {bad.size} subproblem(s) "
+                           "infeasible/non-converged at candidate; "
+                           "adding cuts from feasible scenarios only")
+                if not self.single_cut and scen_ok.any():
+                    self._add_cuts(xhat, np.where(scen_ok, q, -np.inf),
+                                   grad, only=scen_ok)
+            xhat, root_obj = self._root_solve()
+            self.outer_bound = root_obj
+
+            gap = abs(self.inner_bound - self.outer_bound) / (
+                1e-12 + abs(self.outer_bound))
+            if self.verbose or k % 5 == 0 or k == 1:
+                global_toc(f"L-shaped iter {k:3d} outer={root_obj:.6g} "
+                           f"inner={self.inner_bound:.6g} gap={gap:.3e}")
+            if self.spcomm is not None:
+                self.spcomm.sync()
+                if self.spcomm.is_converged():
+                    global_toc(f"L-shaped terminated by hub at iter {k}")
+                    break
+            if gap <= self.tol:
+                global_toc(f"L-shaped converged at iter {k} "
+                           f"(gap {gap:.3e})")
+                break
+        self.first_stage_solution = self.best_xhat
+        return self.outer_bound, self.inner_bound, self.best_xhat
+
+    def _ib_better(self, new, old):
+        return new < old if self.is_minimizing else new > old
+
+    def _reduced_costs(self, res):
+        """r = c + qdiag*x + A'y per scenario (user space)."""
+        b = self.batch
+        aty = jnp.einsum("smn,sm->sn", b.A, res.y)
+        return b.c + b.qdiag * res.x + aty
+
+    # xhat for spokes
+    def root_xbar(self):
+        return self.best_xhat

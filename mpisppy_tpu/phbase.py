@@ -158,9 +158,8 @@ class PHBase(SPOpt):
         # var.fix() instead, spopt.py:592-740)
         self.lb_eff = self.batch.lb
         self.ub_eff = self.batch.ub
-        # dynamic solver tolerance (Gapper analog) as a jnp scalar —
-        # traced, so schedule changes don't recompile
-        self.solver_eps = jnp.asarray(self.solver.eps, self.batch.c.dtype)
+        # (solver_eps lives on SPOpt so solve_loop callers — Iter0,
+        # spokes, xhat evaluation — honor the Gapper schedule too)
 
         # optional converger (reference phbase.py:726-755 PH_Prep wires
         # options["ph_converger"]; convergers/converger.py API)

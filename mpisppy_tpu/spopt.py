@@ -54,6 +54,9 @@ class SPOpt(SPBase):
         self._x_warm = None
         self._y_warm = None
         self._solve_times = []
+        # dynamic solver tolerance (Gapper schedules it) as a jnp
+        # scalar — traced, so schedule changes never recompile
+        self.solver_eps = jnp.asarray(self.solver.eps, self.batch.c.dtype)
 
     # -- hot path ---------------------------------------------------------
     def solve_loop(self, c=None, qdiag=None, lb=None, ub=None,
@@ -75,6 +78,7 @@ class SPOpt(SPBase):
             obj_const=b.obj_const,
             x0=self._x_warm if warm else None,
             y0=self._y_warm if warm else None,
+            eps=self.solver_eps,
         )
         if warm:
             self._x_warm = res.x

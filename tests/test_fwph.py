@@ -1,0 +1,62 @@
+"""FWPH tests on farmer (reference analog: fwph usage in
+examples/farmer + test_ef_ph.py FWPH cases)."""
+
+import numpy as np
+
+from mpisppy_tpu.fwph import FWPH
+from mpisppy_tpu.models import farmer
+
+
+def make_fwph(num_scens=3, **extra):
+    opts = {"defaultPHrho": 2.0, "PHIterLimit": 20, "convthresh": 1e-4,
+            "pdhg_eps": 1e-7, "FW_iter_limit": 3, "column_bank": 20}
+    opts.update(extra)
+    b = farmer.build_batch(num_scens)
+    return FWPH(opts, [f"scen{i}" for i in range(num_scens)], batch=b)
+
+
+def test_fwph_farmer_dual_bound():
+    fw = make_fwph(PHIterLimit=60, convthresh=1e-5)
+    conv, eobj, dual_bound = fw.fwph_main()
+    # the dual bound must be a valid outer bound on -108390, and for
+    # the continuous farmer the Lagrangian dual is tight
+    assert dual_bound <= -108389.0
+    assert dual_bound >= -115406.0   # at least the wait-and-see bound
+    assert abs(dual_bound - -108390.0) < 50.0
+
+
+def test_fwph_hull_point_converges():
+    fw = make_fwph(PHIterLimit=60, convthresh=1e-5)
+    conv, eobj, _ = fw.fwph_main()
+    xbar = np.asarray(fw.state.xbar[0])
+    assert np.allclose(xbar, [170.0, 80.0, 250.0], atol=10.0)
+    assert abs(eobj - -108390.0) < 200.0
+
+
+def test_fwph_dual_bounds_monotone_best():
+    fw = make_fwph(PHIterLimit=8, convthresh=0.0)
+    fw.fwph_main()
+    seq = fw._dual_bounds
+    assert len(seq) >= 8
+    assert fw.dual_bound == max(seq)
+
+
+def test_fwph_spoke_with_ph_hub():
+    from mpisppy_tpu.cylinders.fwph_spoke import FrankWolfeOuterBound
+    from mpisppy_tpu.cylinders.hub import PHHub
+    from mpisppy_tpu.opt.ph import PH
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+
+    names = [f"scen{i}" for i in range(3)]
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": 25, "convthresh": 1e-5,
+            "pdhg_eps": 1e-7}
+    hub = {"hub_class": PHHub, "opt_class": PH,
+           "hub_kwargs": {"options": {"rel_gap": 1e-3}},
+           "opt_kwargs": {"options": opts, "all_scenario_names": names,
+                          "batch": farmer.build_batch(3)}}
+    spoke = {"spoke_class": FrankWolfeOuterBound, "opt_class": FWPH,
+             "opt_kwargs": {"options": dict(opts, FW_iter_limit=2),
+                            "all_scenario_names": names}}
+    ws = WheelSpinner(hub, [spoke]).spin()
+    assert ws.BestOuterBound <= -108388.0
+    assert ws.BestOuterBound >= -115406.0

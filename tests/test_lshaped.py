@@ -1,0 +1,64 @@
+"""L-shaped / Benders tests on farmer (reference analog:
+test_ef_ph.py L-shaped cases + examples/farmer/farmer_lshapedhub.py)."""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.opt.lshaped import LShapedMethod
+
+
+def make_ls(num_scens=3, **extra):
+    opts = {"max_iter": 40, "tol": 1e-5, "pdhg_eps": 1e-7}
+    opts.update(extra)
+    b = farmer.build_batch(num_scens)
+    return LShapedMethod(opts, [f"scen{i}" for i in range(num_scens)],
+                         batch=b)
+
+
+def test_lshaped_farmer_golden():
+    ls = make_ls()
+    outer, inner, xhat = ls.lshaped_algorithm()
+    # both bounds bracket and approach the EF optimum -108390
+    assert outer <= -108389.0 + 1.0
+    assert inner >= -108391.0 - 1.0
+    assert abs(inner - -108390.0) < 30.0
+    assert abs(outer - -108390.0) < 30.0
+    assert np.allclose(xhat, [170.0, 80.0, 250.0], atol=2.0)
+
+
+def test_lshaped_single_cut():
+    ls = make_ls(single_cut=True, max_iter=80)
+    outer, inner, xhat = ls.lshaped_algorithm()
+    assert abs(inner - -108390.0) < 50.0
+
+
+def test_lshaped_bounds_bracket_each_iteration():
+    ls = make_ls(max_iter=10, tol=0.0)
+    outer, inner, _ = ls.lshaped_algorithm()
+    # outer (root relaxation) must never exceed inner (feasible eval)
+    # beyond first-order solver tolerance
+    assert outer <= inner + 1e-5 * abs(inner)
+
+
+def test_lshaped_hub_with_xhat_spoke():
+    from mpisppy_tpu.cylinders.hub import LShapedHub
+    from mpisppy_tpu.cylinders.lshaped_bounder import XhatLShapedInnerBound
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+    from mpisppy_tpu.utils.xhat_eval import Xhat_Eval
+
+    opts = {"max_iter": 25, "tol": 1e-6, "pdhg_eps": 1e-7,
+            "rel_gap": 1e-4}
+    names = [f"scen{i}" for i in range(3)]
+    b = farmer.build_batch(3)
+    hub = {"hub_class": LShapedHub, "opt_class": LShapedMethod,
+           "hub_kwargs": {"options": {"rel_gap": 1e-4}},
+           "opt_kwargs": {"options": opts, "all_scenario_names": names,
+                          "batch": b}}
+    spoke = {"spoke_class": XhatLShapedInnerBound, "opt_class": Xhat_Eval,
+             "opt_kwargs": {"options": dict(opts),
+                            "all_scenario_names": names}}
+    ws = WheelSpinner(hub, [spoke]).spin()
+    assert abs(ws.BestInnerBound - -108390.0) < 50.0
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-5 * abs(
+        ws.BestInnerBound)
