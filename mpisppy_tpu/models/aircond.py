@@ -1,0 +1,136 @@
+"""AIRCOND — multistage production/inventory model (structure parity
+with the reference's aircond, mpisppy/tests/examples/aircond.py, the
+CI-interval and proper-bundle workhorse).
+
+T stages (T = len(branching_factors) + 1).  Per stage t: regular
+production p_t in [0, cap] at unit cost cp, overtime o_t >= 0 at cost
+co > cp, inventory I_t >= 0 at holding cost ch, backlog b_t >= 0 at
+penalty cb.  Demand d_t is stochastic from stage 2 on (branch-indexed
+around a base seasonal profile):
+
+    I_t - b_t = I_{t-1} - b_{t-1} + p_t + o_t - d_t      (balance)
+    min E[ sum_t cp*p_t + co*o_t + ch*I_t + cb*b_t ]
+
+Nonants per stage t < T: [p_t, o_t, I_t, b_t] (stage-major layout,
+matching the reference's per-node nonant lists).
+
+Demand decoding: stage-1 demand is the base; the stage-(t+1) branch
+digit k (0-based over bf) maps to base * (0.6 + 0.8 * k / (bf - 1)),
+so the middle child reproduces the base profile.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ir import ScenarioBatch, TreeInfo
+from ..scenario_tree import MultistageTree
+
+INF = float("inf")
+
+_CAP = 200.0
+_CP = 1.0
+_CO = 3.0
+_CH = 0.5
+_CB = 5.0
+_BASE_DEMAND = 180.0
+_START_INV = 20.0
+
+
+def stage_demand(t, digit, bf):
+    """Demand at stage t (1-based) given the branch digit taken to
+    reach it (digit=None for stage 1)."""
+    base = _BASE_DEMAND * (1.0 + 0.1 * np.sin(1.0 + t))
+    if digit is None or bf <= 1:
+        return base
+    return base * (0.6 + 0.8 * digit / (bf - 1))
+
+
+def build_batch(branching_factors=(3, 2), start_seed=0,
+                dtype=np.float64):
+    tree = MultistageTree(list(branching_factors))
+    S = tree.num_scens
+    T = len(branching_factors) + 1
+    # layout: stage-major [p_t, o_t, I_t, b_t] for t = 1..T
+    N = 4 * T
+    M = T
+    ip = lambda t: 4 * t
+    io = lambda t: 4 * t + 1
+    ii = lambda t: 4 * t + 2
+    ib = lambda t: 4 * t + 3
+
+    A = np.zeros((S, M, N), dtype=dtype)
+    row_lo = np.full((S, M), -INF, dtype=dtype)
+    row_hi = np.full((S, M), INF, dtype=dtype)
+
+    dem = np.zeros((S, T))
+    for s in range(S):
+        digits = tree.scen_digits(s)
+        dem[s, 0] = stage_demand(1, None, 1)
+        for t in range(1, T):
+            dem[s, t] = stage_demand(t + 1, digits[t - 1],
+                                     branching_factors[t - 1])
+
+    for t in range(T):
+        # I_t - b_t - I_{t-1} + b_{t-1} - p_t - o_t = -d_t (+start inv)
+        A[:, t, ii(t)] = 1.0
+        A[:, t, ib(t)] = -1.0
+        A[:, t, ip(t)] = -1.0
+        A[:, t, io(t)] = -1.0
+        if t > 0:
+            A[:, t, ii(t - 1)] = -1.0
+            A[:, t, ib(t - 1)] = 1.0
+        rhs = -dem[:, t] + (_START_INV if t == 0 else 0.0)
+        row_lo[:, t] = rhs
+        row_hi[:, t] = rhs
+
+    lb = np.zeros((S, N), dtype=dtype)
+    ub = np.full((S, N), INF, dtype=dtype)
+    for t in range(T):
+        ub[:, ip(t)] = _CAP
+
+    c = np.zeros((S, N), dtype=dtype)
+    stage_cost_c = np.zeros((T, S, N), dtype=dtype)
+    for t in range(T):
+        c[:, ip(t)] = _CP
+        c[:, io(t)] = _CO
+        c[:, ii(t)] = _CH
+        c[:, ib(t)] = _CB
+        stage_cost_c[t, :, ip(t)] = _CP
+        stage_cost_c[t, :, io(t)] = _CO
+        stage_cost_c[t, :, ii(t)] = _CH
+        stage_cost_c[t, :, ib(t)] = _CB
+
+    # nonants: stages 1..T-1, stage-major
+    nonant_idx = np.array(
+        [j for t in range(T - 1) for j in (ip(t), io(t), ii(t), ib(t))],
+        np.int32)
+    stage_of = tuple(t + 1 for t in range(T - 1) for _ in range(4))
+    node_of = np.stack([
+        tree.node_of_slots(s, stage_of) for s in range(S)
+    ]).astype(np.int32)
+
+    var_names = tuple(
+        f"{nm}[{t+1}]" for t in range(T)
+        for nm in ("RegularProd", "OvertimeProd", "Inventory", "Backlog"))
+    # var_names above is stage-major per t in order p,o,I,b
+    tree_info = TreeInfo(
+        node_of=node_of,
+        prob=np.array([tree.scen_probability(s) for s in range(S)],
+                      dtype=dtype),
+        num_nodes=tree.num_nodes,
+        stage_of=stage_of,
+        nonant_names=tuple(var_names[i] for i in nonant_idx),
+        scen_names=tuple(f"Scenario{s+1}" for s in range(S)),
+    )
+    return ScenarioBatch(
+        c=c, qdiag=np.zeros((S, N), dtype=dtype),
+        A=A, row_lo=row_lo, row_hi=row_hi, lb=lb, ub=ub,
+        obj_const=np.zeros((S,), dtype=dtype),
+        nonant_idx=nonant_idx,
+        integer_mask=np.zeros((S, N), dtype=bool),
+        tree=tree_info, stage_cost_c=stage_cost_c, var_names=var_names)
+
+
+def scenario_names_creator(num_scens, start=0):
+    return [f"Scenario{i+1}" for i in range(start, start + num_scens)]

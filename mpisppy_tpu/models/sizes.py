@@ -1,0 +1,190 @@
+"""SIZES — 2-stage production-sizes MIP (structure parity with the
+reference's sizes model, mpisppy/tests/examples/sizes/sizes.py, the
+Jorjani-Scott-Woodruff product-sizes problem).
+
+A manufacturer produces a product in `num_sizes` sizes over two
+periods.  A size-i unit can be cut down to serve demand for any size
+j <= i at a cutting cost.  Producing any amount of size i in a period
+incurs a setup (binary).  First-period demand is known; second-period
+demand is random.
+
+Per scenario, variables (stage-major; F = num_sizes):
+    z1[i]  in {0,1}  setup, period 1            (nonant)
+    x1[i]  >= 0      production, period 1       (nonant)
+    y1[i,j] (i>=j)   cut i->j, period 1         (nonant)
+    z2[i], x2[i], y2[i,j]                       (recourse)
+Constraints:
+    x_t[i] <= M * z_t[i]                        (setup forcing)
+    sum_j y1[i,j] <= x1[i]                      (cut from period-1 prod)
+    sum_j y2[i,j] <= x1[i] - sum_j y1[i,j] + x2[i]   (leftover + new)
+    sum_{i>=j} y1[i,j] >= d1[j]                 (period-1 demand)
+    sum_{i>=j} y2[i,j] >= d2_s[j]               (period-2 demand, random)
+    sum_i x_t[i] <= cap                         (capacity per period)
+Objective: setup + production + cutting-penalty costs, both periods.
+
+Data is generated from a fixed seed (documented synthetic instance —
+the reference ships literal data tables; we generate the same SHAPE of
+instance parametrically).  NOTE the model-structure parity is what the
+tests pin down (EF == scipy linprog on the relaxation).
+
+`rho_setter` mirrors the reference's sizes rho_setter example
+(examples/sizes/sizes_demo.py): rho proportional to the cost
+coefficient of each nonant.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ir import ScenarioBatch, TreeInfo
+
+INF = float("inf")
+
+
+def _instance_data(num_sizes, seed=1134):
+    rng = np.random.RandomState(seed)
+    F = num_sizes
+    setup_cost = 200.0 + 50.0 * rng.rand(F) * np.arange(1, F + 1)
+    prod_cost = 2.0 + rng.rand(F)
+    cut_cost = 0.2
+    d1 = np.round(100.0 + 100.0 * rng.rand(F))
+    d2_base = np.round(100.0 + 100.0 * rng.rand(F))
+    cap = float(np.ceil(1.75 * max(d1.sum(), d2_base.sum())))
+    return dict(setup_cost=setup_cost, prod_cost=prod_cost,
+                cut_cost=cut_cost, d1=d1, d2_base=d2_base, cap=cap)
+
+
+def scenario_demand(scennum, num_scens, num_sizes, seed=1134):
+    """Period-2 demand for scenario scennum: the base vector scaled by
+    an equally-spaced factor in [0.7, 1.3] (3 scenarios reproduce the
+    classic low/mid/high pattern)."""
+    data = _instance_data(num_sizes, seed)
+    if num_scens == 1:
+        f = 1.0
+    else:
+        f = 0.7 + 0.6 * scennum / (num_scens - 1)
+    return np.round(data["d2_base"] * f)
+
+
+def build_batch(num_scens, num_sizes=3, seed=1134, dtype=np.float64):
+    F = num_sizes
+    data = _instance_data(F, seed)
+    S = num_scens
+    pairs = [(i, j) for i in range(F) for j in range(F) if i >= j]
+    P = len(pairs)
+
+    # layout: [z1 | x1 | y1 | z2 | x2 | y2]
+    iz1, ix1, iy1 = 0, F, 2 * F
+    iz2, ix2, iy2 = 2 * F + P, 3 * F + P, 4 * F + P
+    N = 4 * F + 2 * P
+
+    # rows: forcing (2F), cut-avail p1 (F), cut-avail p2 (F),
+    # demand p1 (F), demand p2 (F), capacity (2)
+    M = 6 * F + 2
+    A = np.zeros((S, M, N), dtype=dtype)
+    row_lo = np.full((S, M), -INF, dtype=dtype)
+    row_hi = np.full((S, M), INF, dtype=dtype)
+    r = 0
+    capM = data["cap"]
+    for i in range(F):                      # x1 - M z1 <= 0
+        A[:, r, ix1 + i] = 1.0
+        A[:, r, iz1 + i] = -capM
+        row_hi[:, r] = 0.0
+        r += 1
+    for i in range(F):                      # x2 - M z2 <= 0
+        A[:, r, ix2 + i] = 1.0
+        A[:, r, iz2 + i] = -capM
+        row_hi[:, r] = 0.0
+        r += 1
+    for i in range(F):                      # sum_j y1[i,.] - x1 <= 0
+        for p, (pi, pj) in enumerate(pairs):
+            if pi == i:
+                A[:, r, iy1 + p] = 1.0
+        A[:, r, ix1 + i] = -1.0
+        row_hi[:, r] = 0.0
+        r += 1
+    for i in range(F):    # sum_j y2[i,.] + sum_j y1[i,.] - x1 - x2 <= 0
+        for p, (pi, pj) in enumerate(pairs):
+            if pi == i:
+                A[:, r, iy2 + p] = 1.0
+                A[:, r, iy1 + p] = 1.0
+        A[:, r, ix1 + i] = -1.0
+        A[:, r, ix2 + i] = -1.0
+        row_hi[:, r] = 0.0
+        r += 1
+    for j in range(F):                      # sum_{i>=j} y1[.,j] >= d1
+        for p, (pi, pj) in enumerate(pairs):
+            if pj == j:
+                A[:, r, iy1 + p] = 1.0
+        row_lo[:, r] = data["d1"][j]
+        r += 1
+    d2 = np.stack([scenario_demand(s, S, F, seed) for s in range(S)])
+    for j in range(F):                      # sum_{i>=j} y2[.,j] >= d2_s
+        for p, (pi, pj) in enumerate(pairs):
+            if pj == j:
+                A[:, r, iy2 + p] = 1.0
+        row_lo[:, r] = d2[:, j]
+        r += 1
+    A[:, r, ix1:ix1 + F] = 1.0              # capacity p1
+    row_hi[:, r] = data["cap"]
+    r += 1
+    A[:, r, ix2:ix2 + F] = 1.0              # capacity p2
+    row_hi[:, r] = data["cap"]
+    r += 1
+    assert r == M
+
+    lb = np.zeros((S, N), dtype=dtype)
+    ub = np.full((S, N), INF, dtype=dtype)
+    ub[:, iz1:iz1 + F] = 1.0
+    ub[:, iz2:iz2 + F] = 1.0
+
+    c = np.zeros((S, N), dtype=dtype)
+    c[:, iz1:iz1 + F] = data["setup_cost"]
+    c[:, iz2:iz2 + F] = data["setup_cost"]
+    c[:, ix1:ix1 + F] = data["prod_cost"]
+    c[:, ix2:ix2 + F] = data["prod_cost"]
+    for p, (pi, pj) in enumerate(pairs):    # cutting penalty ~ distance
+        c[:, iy1 + p] = data["cut_cost"] * (pi - pj)
+        c[:, iy2 + p] = data["cut_cost"] * (pi - pj)
+
+    integer_mask = np.zeros((S, N), dtype=bool)
+    integer_mask[:, iz1:iz1 + F] = True
+    integer_mask[:, iz2:iz2 + F] = True
+
+    stage_cost_c = np.zeros((2, S, N), dtype=dtype)
+    stage_cost_c[0, :, : 2 * F + P] = c[:, : 2 * F + P]
+    stage_cost_c[1, :, 2 * F + P:] = c[:, 2 * F + P:]
+
+    nonant_idx = np.arange(0, 2 * F + P, dtype=np.int32)
+    var_names = (
+        tuple(f"z1[{i}]" for i in range(F))
+        + tuple(f"x1[{i}]" for i in range(F))
+        + tuple(f"y1[{i},{j}]" for i, j in pairs)
+        + tuple(f"z2[{i}]" for i in range(F))
+        + tuple(f"x2[{i}]" for i in range(F))
+        + tuple(f"y2[{i},{j}]" for i, j in pairs))
+    tree = TreeInfo(
+        node_of=np.zeros((S, len(nonant_idx)), np.int32),
+        prob=np.full((S,), 1.0 / S, dtype=dtype),
+        num_nodes=1,
+        stage_of=(1,) * len(nonant_idx),
+        nonant_names=tuple(var_names[i] for i in nonant_idx),
+        scen_names=tuple(f"Scenario{i+1}" for i in range(S)),
+    )
+    return ScenarioBatch(
+        c=c, qdiag=np.zeros((S, N), dtype=dtype),
+        A=A, row_lo=row_lo, row_hi=row_hi, lb=lb, ub=ub,
+        obj_const=np.zeros((S,), dtype=dtype),
+        nonant_idx=nonant_idx, integer_mask=integer_mask,
+        tree=tree, stage_cost_c=stage_cost_c, var_names=var_names)
+
+
+def rho_setter(batch, rho_scale_factor=1.0):
+    """Cost-proportional rho (reference: examples/sizes rho_setter):
+    rho_k = scale * |c_k| / 2 at each nonant slot, floored at scale."""
+    c_na = np.abs(np.asarray(batch.c))[:, np.asarray(batch.nonant_idx)]
+    return np.maximum(rho_scale_factor * c_na / 2.0, rho_scale_factor)
+
+
+def scenario_names_creator(num_scens, start=0):
+    return [f"Scenario{i+1}" for i in range(start, start + num_scens)]

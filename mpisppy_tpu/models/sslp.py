@@ -1,0 +1,119 @@
+"""SSLP — 2-stage stochastic server location (structure parity with the
+reference's sslp model, examples/sslp/sslp.py, from Ntaimo & Sen's
+SIPLIB instances sslp_m_n_S).
+
+First stage: open server at site j (binary x_j, cost cs_j), at most
+`max_servers` open.  Second stage: client i is PRESENT with scenario
+indicator h_i^s in {0,1}; present clients are assigned to open sites
+(y_ij in [0,1], relaxed binaries), earning revenue q_ij (negative
+cost); site capacity u limits the assigned load sum_i d_i y_ij; an
+overflow variable o_j (penalty) keeps recourse complete.
+
+    min  sum_j cs_j x_j - sum_ij q_ij y_ij + pen * sum_j o_j
+    s.t. sum_j y_ij  = h_i^s                 (assign present clients)
+         sum_i d_i y_ij - u x_j - o_j <= 0   (capacity if open)
+         sum_j x_j <= max_servers
+Nonants: x (binary).
+
+Instance data generated from a fixed seed: d_i ~ U{5..20},
+q_ij ~ U{10..40}, cs_j ~ U{40..80}, u = ceil(1.5 * sum d / m).
+Naming mirrors SIPLIB: build_batch(num_scens, m_sites, n_clients).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ir import ScenarioBatch, TreeInfo
+
+INF = float("inf")
+
+
+def _instance(m, n, seed=365):
+    rng = np.random.RandomState(seed)
+    d = rng.randint(5, 21, size=n).astype(float)
+    q = rng.randint(10, 41, size=(n, m)).astype(float)
+    cs = rng.randint(40, 81, size=m).astype(float)
+    u = float(np.ceil(1.5 * d.sum() / m))
+    return d, q, cs, u
+
+
+def client_presence(scennum, num_scens, n_clients, seed=365):
+    """(n,) 0/1 presence vector; each client present w.p. 0.5 (the
+    SIPLIB convention), scenario-seeded."""
+    rng = np.random.RandomState(seed + 1000 + scennum)
+    return (rng.rand(n_clients) < 0.5).astype(float)
+
+
+def build_batch(num_scens, m_sites=5, n_clients=10, max_servers=None,
+                overflow_penalty=1000.0, seed=365, dtype=np.float64):
+    m, n, S = m_sites, n_clients, num_scens
+    d, q, cs, u = _instance(m, n, seed)
+    if max_servers is None:
+        max_servers = m
+
+    # layout: [x (m) | y (n*m, client-major) | o (m)]
+    ix, iy, io = 0, m, m + n * m
+    N = m + n * m + m
+    # rows: n assignment equalities + m capacity + 1 cardinality
+    M = n + m + 1
+    A = np.zeros((S, M, N), dtype=dtype)
+    row_lo = np.full((S, M), -INF, dtype=dtype)
+    row_hi = np.full((S, M), INF, dtype=dtype)
+
+    h = np.stack([client_presence(s, S, n, seed) for s in range(S)])
+    for i in range(n):                       # sum_j y_ij = h_i
+        A[:, i, iy + i * m: iy + (i + 1) * m] = 1.0
+        row_lo[:, i] = h[:, i]
+        row_hi[:, i] = h[:, i]
+    for j in range(m):                       # sum_i d_i y_ij - u x_j - o_j <= 0
+        r = n + j
+        for i in range(n):
+            A[:, r, iy + i * m + j] = d[i]
+        A[:, r, ix + j] = -u
+        A[:, r, io + j] = -1.0
+        row_hi[:, r] = 0.0
+    A[:, n + m, ix:ix + m] = 1.0             # cardinality
+    row_hi[:, n + m] = float(max_servers)
+
+    lb = np.zeros((S, N), dtype=dtype)
+    ub = np.full((S, N), INF, dtype=dtype)
+    ub[:, ix:ix + m] = 1.0
+    ub[:, iy:io] = 1.0
+
+    c = np.zeros((S, N), dtype=dtype)
+    c[:, ix:ix + m] = cs
+    c[:, iy:io] = -q.reshape(-1)
+    c[:, io:] = overflow_penalty
+
+    integer_mask = np.zeros((S, N), dtype=bool)
+    integer_mask[:, ix:ix + m] = True
+
+    stage_cost_c = np.zeros((2, S, N), dtype=dtype)
+    stage_cost_c[0, :, ix:ix + m] = cs
+    stage_cost_c[1] = c.copy()
+    stage_cost_c[1, :, ix:ix + m] = 0.0
+
+    nonant_idx = np.arange(m, dtype=np.int32)
+    var_names = (
+        tuple(f"x[{j}]" for j in range(m))
+        + tuple(f"y[{i},{j}]" for i in range(n) for j in range(m))
+        + tuple(f"o[{j}]" for j in range(m)))
+    tree = TreeInfo(
+        node_of=np.zeros((S, m), np.int32),
+        prob=np.full((S,), 1.0 / S, dtype=dtype),
+        num_nodes=1,
+        stage_of=(1,) * m,
+        nonant_names=var_names[:m],
+        scen_names=tuple(f"Scenario{i+1}" for i in range(S)),
+    )
+    return ScenarioBatch(
+        c=c, qdiag=np.zeros((S, N), dtype=dtype),
+        A=A, row_lo=row_lo, row_hi=row_hi, lb=lb, ub=ub,
+        obj_const=np.zeros((S,), dtype=dtype),
+        nonant_idx=nonant_idx, integer_mask=integer_mask,
+        tree=tree, stage_cost_c=stage_cost_c, var_names=var_names)
+
+
+def scenario_names_creator(num_scens, start=0):
+    return [f"Scenario{i+1}" for i in range(start, start + num_scens)]

@@ -1,0 +1,162 @@
+"""UC — stochastic unit commitment (structure parity with the
+reference's uc model family, examples/uc/uc_funcs.py, which wraps
+egret; here a self-contained DC-less UC with the same stochastic
+shape: first-stage commitment, per-scenario wind).
+
+G thermal units, H hours.  First stage: commitment u_gh in {0,1} and
+startup s_gh >= 0.  Second stage, per wind scenario w: dispatch
+p_gh >= 0 and load shed sh_h >= 0:
+
+    p_gh <= Pmax_g * u_gh ;  p_gh >= Pmin_g * u_gh
+    sum_g p_gh + wind^s_h + sh_h >= demand_h        (balance)
+    s_gh >= u_gh - u_g,h-1                          (startup def)
+    |p_gh - p_g,h-1| <= ramp_g                      (ramping)
+    min sum_gh (cNL_g u_gh + cSU_g s_gh) +
+        E[ sum_gh cV_g p_gh + pen * sum_h sh_h ]
+Nonants: u, s (first stage).
+
+Unit data is a fixed small fleet; wind is a seeded hourly profile per
+scenario (the reference's 3..1000 wind-scenario instances).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ir import ScenarioBatch, TreeInfo
+
+INF = float("inf")
+
+# fleet: Pmin, Pmax, ramp, cNL (no-load), cSU (startup), cV (variable)
+_FLEET = np.array([
+    # Pmin  Pmax  ramp  cNL   cSU    cV
+    [100.0, 400.0, 150.0, 500.0, 800.0, 15.0],    # big coal-ish
+    [50.0, 200.0, 100.0, 300.0, 400.0, 25.0],     # mid gas
+    [10.0, 100.0, 100.0, 100.0, 100.0, 40.0],     # peaker
+])
+_PEN = 1000.0
+
+
+def demand_profile(H):
+    hours = np.arange(H)
+    return 350.0 + 150.0 * np.sin(np.pi * (hours + 2) / (H / 1.5))
+
+
+def wind_profile(scennum, H, seed=91):
+    rng = np.random.RandomState(seed + 17 * scennum)
+    base = 80.0 + 60.0 * rng.rand()
+    wiggle = 40.0 * rng.rand(H)
+    return np.maximum(0.0, base + wiggle - 20.0)
+
+
+def build_batch(num_scens, H=6, n_units=None, seed=91, dtype=np.float64):
+    fleet = _FLEET if n_units is None else _FLEET[:n_units]
+    G = len(fleet)
+    S = num_scens
+    Pmin, Pmax, ramp, cNL, cSU, cV = fleet.T
+
+    # layout: [u (G*H) | s (G*H) | p (G*H) | sh (H)], unit-major blocks
+    iu, isu, ip, ish = 0, G * H, 2 * G * H, 3 * G * H
+    N = 3 * G * H + H
+
+    def uidx(g, h):
+        return iu + g * H + h
+
+    def sidx(g, h):
+        return isu + g * H + h
+
+    def pidx(g, h):
+        return ip + g * H + h
+
+    # rows: pmax (GH), pmin (GH), balance (H), startup (GH),
+    # ramp up (G(H-1)), ramp down (G(H-1))
+    M = 3 * G * H + H + 2 * G * (H - 1)
+    A = np.zeros((S, M, N), dtype=dtype)
+    row_lo = np.full((S, M), -INF, dtype=dtype)
+    row_hi = np.full((S, M), INF, dtype=dtype)
+    r = 0
+    for g in range(G):
+        for h in range(H):
+            A[:, r, pidx(g, h)] = 1.0      # p - Pmax u <= 0
+            A[:, r, uidx(g, h)] = -Pmax[g]
+            row_hi[:, r] = 0.0
+            r += 1
+    for g in range(G):
+        for h in range(H):
+            A[:, r, pidx(g, h)] = 1.0      # p - Pmin u >= 0
+            A[:, r, uidx(g, h)] = -Pmin[g]
+            row_lo[:, r] = 0.0
+            r += 1
+    dem = demand_profile(H)
+    wind = np.stack([wind_profile(s, H, seed) for s in range(S)])
+    for h in range(H):                     # balance
+        for g in range(G):
+            A[:, r, pidx(g, h)] = 1.0
+        A[:, r, ish + h] = 1.0
+        row_lo[:, r] = dem[h] - wind[:, h]
+        r += 1
+    for g in range(G):                     # s_gh >= u_gh - u_g,h-1
+        for h in range(H):
+            A[:, r, sidx(g, h)] = 1.0
+            A[:, r, uidx(g, h)] = -1.0
+            if h > 0:
+                A[:, r, uidx(g, h - 1)] = 1.0
+            row_lo[:, r] = 0.0
+            r += 1
+    for g in range(G):                     # ramping
+        for h in range(1, H):
+            A[:, r, pidx(g, h)] = 1.0
+            A[:, r, pidx(g, h - 1)] = -1.0
+            row_hi[:, r] = ramp[g]
+            r += 1
+    for g in range(G):
+        for h in range(1, H):
+            A[:, r, pidx(g, h)] = -1.0
+            A[:, r, pidx(g, h - 1)] = 1.0
+            row_hi[:, r] = ramp[g]
+            r += 1
+    assert r == M
+
+    lb = np.zeros((S, N), dtype=dtype)
+    ub = np.full((S, N), INF, dtype=dtype)
+    ub[:, iu:isu] = 1.0
+    ub[:, isu:ip] = 1.0
+
+    c = np.zeros((S, N), dtype=dtype)
+    for g in range(G):
+        c[:, iu + g * H: iu + (g + 1) * H] = cNL[g]
+        c[:, isu + g * H: isu + (g + 1) * H] = cSU[g]
+        c[:, ip + g * H: ip + (g + 1) * H] = cV[g]
+    c[:, ish:] = _PEN
+
+    integer_mask = np.zeros((S, N), dtype=bool)
+    integer_mask[:, iu:isu] = True
+
+    stage_cost_c = np.zeros((2, S, N), dtype=dtype)
+    stage_cost_c[0, :, : 2 * G * H] = c[:, : 2 * G * H]
+    stage_cost_c[1, :, 2 * G * H:] = c[:, 2 * G * H:]
+
+    nonant_idx = np.arange(2 * G * H, dtype=np.int32)
+    var_names = (
+        tuple(f"u[{g},{h}]" for g in range(G) for h in range(H))
+        + tuple(f"su[{g},{h}]" for g in range(G) for h in range(H))
+        + tuple(f"p[{g},{h}]" for g in range(G) for h in range(H))
+        + tuple(f"shed[{h}]" for h in range(H)))
+    tree = TreeInfo(
+        node_of=np.zeros((S, 2 * G * H), np.int32),
+        prob=np.full((S,), 1.0 / S, dtype=dtype),
+        num_nodes=1,
+        stage_of=(1,) * (2 * G * H),
+        nonant_names=var_names[: 2 * G * H],
+        scen_names=tuple(f"Scenario{i+1}" for i in range(S)),
+    )
+    return ScenarioBatch(
+        c=c, qdiag=np.zeros((S, N), dtype=dtype),
+        A=A, row_lo=row_lo, row_hi=row_hi, lb=lb, ub=ub,
+        obj_const=np.zeros((S,), dtype=dtype),
+        nonant_idx=nonant_idx, integer_mask=integer_mask,
+        tree=tree, stage_cost_c=stage_cost_c, var_names=var_names)
+
+
+def scenario_names_creator(num_scens, start=0):
+    return [f"Scenario{i+1}" for i in range(start, start + num_scens)]

@@ -1,0 +1,79 @@
+"""Independent EF oracle: assemble the extensive form of a
+ScenarioBatch as one big scipy.optimize.linprog problem (continuous
+relaxation) and solve it with HiGHS.
+
+This is the tests' ground truth for new model lowerings AND for the
+consensus-mode PDHG kernel: per-scenario blocks on the diagonal,
+explicit nonanticipativity equality rows chaining scenarios that share
+a tree node — exactly the reference's EF construction
+(reference sputils.py:209-341) done in scipy instead of Pyomo.
+"""
+
+import numpy as np
+from scipy.optimize import linprog
+
+
+def ef_linprog(batch, n_real=None):
+    """Returns (optimal value, per-scenario x (S, N)) of the EF LP
+    relaxation.  Uses only the first n_real scenarios (drop padding)."""
+    A = np.asarray(batch.A)
+    S = A.shape[0] if n_real is None else n_real
+    A = A[:S]
+    N = A.shape[2]
+    Mr = A.shape[1]
+    prob = np.asarray(batch.prob)[:S]
+    prob = prob / prob.sum()
+    c = (prob[:, None] * np.asarray(batch.c)[:S]).reshape(-1)
+    lo = np.asarray(batch.row_lo)[:S]
+    hi = np.asarray(batch.row_hi)[:S]
+    lb = np.asarray(batch.lb)[:S].reshape(-1)
+    ub = np.asarray(batch.ub)[:S].reshape(-1)
+
+    # inequality rows: block-diagonal, two-sided split into <=
+    rows_ub = []
+    rhs_ub = []
+    rows_eq = []
+    rhs_eq = []
+    for s in range(S):
+        for m in range(Mr):
+            a = np.zeros(S * N)
+            a[s * N:(s + 1) * N] = A[s, m]
+            if np.isfinite(lo[s, m]) and np.isfinite(hi[s, m]) and \
+                    lo[s, m] == hi[s, m]:
+                rows_eq.append(a)
+                rhs_eq.append(lo[s, m])
+                continue
+            if np.isfinite(hi[s, m]):
+                rows_ub.append(a)
+                rhs_ub.append(hi[s, m])
+            if np.isfinite(lo[s, m]):
+                rows_ub.append(-a)
+                rhs_ub.append(-lo[s, m])
+
+    # nonanticipativity: chain equal-node scenario pairs per slot
+    na = np.asarray(batch.nonant_idx)
+    node_of = np.asarray(batch.tree.node_of)[:S]
+    for k, col in enumerate(na):
+        by_node = {}
+        for s in range(S):
+            by_node.setdefault(int(node_of[s, k]), []).append(s)
+        for members in by_node.values():
+            for s1, s2 in zip(members, members[1:]):
+                a = np.zeros(S * N)
+                a[s1 * N + col] = 1.0
+                a[s2 * N + col] = -1.0
+                rows_eq.append(a)
+                rhs_eq.append(0.0)
+
+    res = linprog(
+        c,
+        A_ub=np.array(rows_ub) if rows_ub else None,
+        b_ub=np.array(rhs_ub) if rhs_ub else None,
+        A_eq=np.array(rows_eq) if rows_eq else None,
+        b_eq=np.array(rhs_eq) if rhs_eq else None,
+        bounds=list(zip(np.where(np.isfinite(lb), lb, None),
+                        np.where(np.isfinite(ub), ub, None))),
+        method="highs")
+    assert res.status == 0, f"linprog failed: {res.message}"
+    const = float(prob @ np.asarray(batch.obj_const)[:S])
+    return res.fun + const, res.x.reshape(S, N)
