@@ -1,0 +1,88 @@
+"""farmer_cylinders — the canonical CLI driver (analog of the
+reference's examples/farmer/farmer_cylinders.py, using the same
+cfg -> vanilla -> WheelSpinner pipeline).
+
+    python examples/farmer_cylinders.py --num-scens 3 --lagrangian \\
+        --xhatshuffle --rel-gap 1e-4 --max-iterations 100
+"""
+
+import numpy as np
+
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.spin_the_wheel import WheelSpinner
+from mpisppy_tpu.utils import config, vanilla
+
+
+def _parse_args(args=None):
+    cfg = config.Config()
+    cfg.popular_args()
+    cfg.ph_args()
+    cfg.two_sided_args()
+    cfg.fwph_args()
+    cfg.lagrangian_args()
+    cfg.lagranger_args()
+    cfg.xhatlooper_args()
+    cfg.xhatshuffle_args()
+    cfg.xhatxbar_args()
+    cfg.slammax_args()
+    cfg.slammin_args()
+    cfg.fixer_args()
+    farmer.inparser_adder(cfg)
+    cfg.parse_command_line("farmer_cylinders", args=args)
+    return cfg
+
+
+def main(args=None):
+    cfg = _parse_args(args)
+    num_scens = cfg.num_scens
+    names = farmer.scenario_names_creator(num_scens)
+    batch = farmer.build_batch(
+        num_scens,
+        crops_multiplier=cfg.get("crops_multiplier", 1),
+        use_integer=cfg.get("farmer_with_integers", False))
+
+    hub = vanilla.ph_hub(cfg, farmer.scenario_creator, None, names,
+                         batch=batch)
+    if cfg.get("fixer"):
+        vanilla.add_fixer(hub, cfg)
+    spokes = []
+    if cfg.get("fwph"):
+        spokes.append(vanilla.fwph_spoke(
+            cfg, farmer.scenario_creator, None, names, batch=batch))
+    if cfg.get("lagrangian"):
+        spokes.append(vanilla.lagrangian_spoke(
+            cfg, farmer.scenario_creator, None, names, batch=batch))
+    if cfg.get("lagranger"):
+        spokes.append(vanilla.lagranger_spoke(
+            cfg, farmer.scenario_creator, None, names, batch=batch))
+    if cfg.get("xhatlooper"):
+        spokes.append(vanilla.xhatlooper_spoke(
+            cfg, farmer.scenario_creator, None, names, batch=batch))
+    if cfg.get("xhatshuffle"):
+        spokes.append(vanilla.xhatshuffle_spoke(
+            cfg, farmer.scenario_creator, None, names, batch=batch))
+    if cfg.get("xhatxbar"):
+        spokes.append(vanilla.xhatxbar_spoke(
+            cfg, farmer.scenario_creator, None, names, batch=batch))
+    if cfg.get("slammax"):
+        spokes.append(vanilla.slammax_spoke(
+            cfg, farmer.scenario_creator, None, names, batch=batch))
+    if cfg.get("slammin"):
+        spokes.append(vanilla.slammin_spoke(
+            cfg, farmer.scenario_creator, None, names, batch=batch))
+
+    ws = WheelSpinner(hub, spokes).spin()
+    print(f"BestInnerBound = {ws.BestInnerBound}")
+    print(f"BestOuterBound = {ws.BestOuterBound}")
+    if cfg.get("solution_base_name"):
+        sol = ws.best_nonant_solution()
+        if sol is not None:
+            sol = np.asarray(sol)
+            ws.spcomm.opt.write_first_stage_solution(
+                cfg["solution_base_name"] + ".csv",
+                sol[0] if sol.ndim > 1 else sol)
+    return ws
+
+
+if __name__ == "__main__":
+    main()

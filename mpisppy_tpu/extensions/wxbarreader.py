@@ -1,0 +1,21 @@
+"""WXBarReader — warm-start PH from a W/xbar checkpoint (reference:
+mpisppy/utils/wxbarreader.py:36-97).
+
+options["init_W_fname"]: .npz written by WXBarWriter; installed right
+after Iter0 (the reference also loads at init).
+"""
+
+from __future__ import annotations
+
+from ..utils.wxbarutils import read_W_and_xbar
+from .extension import Extension
+
+
+class WXBarReader(Extension):
+    def __init__(self, ph):
+        super().__init__(ph)
+        self.fname = ph.options.get("init_W_fname")
+
+    def post_iter0(self):
+        if self.fname:
+            read_W_and_xbar(self.fname, self.opt)

@@ -1,0 +1,25 @@
+"""WXBarWriter — checkpoint W/xbar during PH (reference:
+mpisppy/utils/wxbarwriter.py:36-102 extension wrapper).
+
+Options (cfg group wxbar_read_write_args): options["W_fname"] — write
+an .npz checkpoint at every iteration (atomic-ish: last write wins) and
+at post_everything.
+"""
+
+from __future__ import annotations
+
+from ..utils.wxbarutils import write_W_and_xbar
+from .extension import Extension
+
+
+class WXBarWriter(Extension):
+    def __init__(self, ph):
+        super().__init__(ph)
+        self.fname = ph.options.get("W_fname")
+
+    def enditer(self):
+        if self.fname and self.opt.state is not None:
+            write_W_and_xbar(self.fname, self.opt)
+
+    def post_everything(self):
+        self.enditer()

@@ -134,3 +134,16 @@ def build_batch(branching_factors=(3, 2), start_seed=0,
 
 def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
+
+
+MULTISTAGE = True
+
+
+def inparser_adder(cfg):
+    cfg.add_branching_factors()
+
+
+def kw_creator(options):
+    from ..utils.config import parse_branching_factors
+    bf = options.get("branching_factors", "3,2")
+    return {"branching_factors": tuple(parse_branching_factors(bf))}

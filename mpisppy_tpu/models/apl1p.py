@@ -125,3 +125,11 @@ def build_batch(num_scens=None, dtype=np.float64):
 
 def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
+
+
+def inparser_adder(cfg):
+    cfg.num_scens_required()
+
+
+def kw_creator(options):
+    return {}

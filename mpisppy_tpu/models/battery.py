@@ -112,3 +112,13 @@ def build_batch(num_scens, H=12, seed=77, dtype=np.float64):
 
 def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
+
+
+def inparser_adder(cfg):
+    cfg.num_scens_required()
+    cfg.add_to_config("battery_hours", description="operation horizon",
+                      domain=int, default=12)
+
+
+def kw_creator(options):
+    return {"H": options.get("battery_hours", 12)}

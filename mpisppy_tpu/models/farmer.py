@@ -201,7 +201,10 @@ def scenario_names_creator(num_scens, start=None):
 
 def kw_creator(options):
     return {
-        "use_integer": options.get("use_integer", False),
+        # CLI flag name is farmer_with_integers (inparser_adder);
+        # programmatic callers may pass use_integer directly
+        "use_integer": options.get(
+            "use_integer", options.get("farmer_with_integers", False)),
         "crops_multiplier": options.get("crops_multiplier", 1),
         "num_scens": options.get("num_scens", None),
     }

@@ -222,8 +222,13 @@ def scenario_names_creator(num_scens, start=None):
     return [f"Scen{i+1}" for i in range(start, start + num_scens)]
 
 
+MULTISTAGE = True
+
+
 def kw_creator(options):
-    return {"branching_factors": options.get("branching_factors", [3, 3])}
+    from ..utils.config import parse_branching_factors
+    bf = options.get("branching_factors", [3, 3])
+    return {"branching_factors": parse_branching_factors(bf)}
 
 
 def inparser_adder(cfg):

@@ -124,3 +124,13 @@ def build_batch(num_scens, n_nodes=6, overflow_penalty=200.0, seed=2077,
 
 def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
+
+
+def inparser_adder(cfg):
+    cfg.num_scens_required()
+    cfg.add_to_config("netdes_nodes", description="network nodes",
+                      domain=int, default=6)
+
+
+def kw_creator(options):
+    return {"n_nodes": options.get("netdes_nodes", 6)}

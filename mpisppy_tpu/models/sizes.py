@@ -188,3 +188,13 @@ def rho_setter(batch, rho_scale_factor=1.0):
 
 def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
+
+
+def inparser_adder(cfg):
+    cfg.num_scens_required()
+    cfg.add_to_config("num_sizes", description="number of product sizes",
+                      domain=int, default=3)
+
+
+def kw_creator(options):
+    return {"num_sizes": options.get("num_sizes", 3)}

@@ -117,3 +117,16 @@ def build_batch(num_scens, m_sites=5, n_clients=10, max_servers=None,
 
 def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
+
+
+def inparser_adder(cfg):
+    cfg.num_scens_required()
+    cfg.add_to_config("m_sites", description="candidate server sites",
+                      domain=int, default=5)
+    cfg.add_to_config("n_clients", description="clients", domain=int,
+                      default=10)
+
+
+def kw_creator(options):
+    return {"m_sites": options.get("m_sites", 5),
+            "n_clients": options.get("n_clients", 10)}

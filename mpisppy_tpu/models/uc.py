@@ -160,3 +160,13 @@ def build_batch(num_scens, H=6, n_units=None, seed=91, dtype=np.float64):
 
 def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
+
+
+def inparser_adder(cfg):
+    cfg.num_scens_required()
+    cfg.add_to_config("uc_hours", description="commitment horizon",
+                      domain=int, default=6)
+
+
+def kw_creator(options):
+    return {"H": options.get("uc_hours", 6)}

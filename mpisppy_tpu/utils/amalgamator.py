@@ -1,0 +1,181 @@
+"""Amalgamator — one-call driver from a model module + Config
+(reference: mpisppy/utils/amalgamator.py, 451 LoC).
+
+Module contract (reference amalgamator.py:123-135): the model module
+must export
+    scenario_names_creator(num_scens, start=0)
+    scenario_creator(name, **kwargs)   OR   build_batch(num_scens, **kw)
+    inparser_adder(cfg)
+    kw_creator(cfg) -> kwargs for the creator / batch builder
+`build_batch` is this framework's fast path (vectorized lowering); when
+present it is preferred and `kw_creator`'s result is passed to it.
+
+Dispatch (reference Amalgamator.run, :292+): cfg.EF mode solves the
+extensive form in one consensus solve; otherwise a WheelSpinner is
+built from cfg flags via the vanilla factories (the reference's
+hubs/spokes compat tables, :52-67).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import numpy as np
+
+from .. import global_toc
+from ..opt.ef import ExtensiveForm
+from ..spin_the_wheel import WheelSpinner
+from . import vanilla
+from .config import Config
+
+
+def from_module(mname, cfg, extraargs_fct=None, use_command_line=True,
+                args=None):
+    """Build an Amalgamator for model module `mname` (reference
+    amalgamator.py:139).  Declares the module's flags on cfg and
+    optionally parses the command line."""
+    m = mname if not isinstance(mname, str) else importlib.import_module(
+        mname)
+    for needed in ("scenario_names_creator", "inparser_adder",
+                   "kw_creator"):
+        if not hasattr(m, needed):
+            raise RuntimeError(
+                f"module {getattr(m, '__name__', m)} missing {needed} "
+                "(amalgamator module contract)")
+    if not (hasattr(m, "build_batch") or hasattr(m, "scenario_creator")):
+        raise RuntimeError("module needs build_batch or scenario_creator")
+    m.inparser_adder(cfg)
+    if extraargs_fct is not None:
+        extraargs_fct(cfg)
+    if use_command_line:
+        cfg.parse_command_line(getattr(m, "__name__", "amalgamator"),
+                               args=args)
+    return Amalgamator(cfg, m)
+
+
+class Amalgamator:
+    def __init__(self, cfg: Config, module):
+        self.cfg = cfg
+        self.module = module
+        self.is_EF = bool(cfg.get("EF", False)) or bool(
+            cfg.get("EF_2stage", False)) or bool(
+            cfg.get("EF_mstage", False))
+        self.best_inner_bound = None
+        self.best_outer_bound = None
+        self.EF_Obj = None
+        self.first_stage_solution = None
+        self.wheel = None
+
+    def _make_batch_and_names(self):
+        cfg, m = self.cfg, self.module
+        kw = dict(m.kw_creator(cfg))
+        kw.pop("num_scens", None)   # build_batch takes it positionally
+        if getattr(m, "MULTISTAGE", False):
+            # multistage modules size themselves from branching factors
+            batch = m.build_batch(**kw)
+            names = m.scenario_names_creator(batch.num_scens)
+            return batch, names, None, None
+        num_scens = int(cfg.get("num_scens", 3))
+        names = m.scenario_names_creator(num_scens)
+        if hasattr(m, "build_batch"):
+            batch = m.build_batch(num_scens, **kw)
+            return batch, names, None, None
+        return None, names, m.scenario_creator, kw
+
+    def run(self):
+        cfg = self.cfg
+        batch, names, creator, ckw = self._make_batch_and_names()
+        opts = cfg.options_dict()
+        if self.is_EF:
+            opts["pdhg_eps"] = cfg.get("EF_solver_eps",
+                                       opts.get("pdhg_eps", 1e-7))
+            ef = ExtensiveForm(opts, names, batch=batch,
+                               scenario_creator=creator,
+                               scenario_creator_kwargs=ckw)
+            ef.solve_extensive_form()
+            self.EF_Obj = ef.get_objective_value()
+            self.best_inner_bound = self.EF_Obj
+            self.best_outer_bound = ef.get_dual_bound()
+            self.first_stage_solution = np.asarray(ef.get_root_solution())
+            global_toc(f"Amalgamator EF obj = {self.EF_Obj:.6g}")
+            return self
+
+        hub = vanilla.ph_hub(cfg, creator, None, names,
+                             scenario_creator_kwargs=ckw, batch=batch)
+        spokes = []
+        if cfg.get("fwph"):
+            spokes.append(vanilla.fwph_spoke(cfg, creator, None, names,
+                                             ckw, batch=batch))
+        if cfg.get("lagrangian"):
+            spokes.append(vanilla.lagrangian_spoke(
+                cfg, creator, None, names, ckw, batch=batch))
+        if cfg.get("lagranger"):
+            spokes.append(vanilla.lagranger_spoke(
+                cfg, creator, None, names, ckw, batch=batch))
+        if cfg.get("xhatlooper"):
+            spokes.append(vanilla.xhatlooper_spoke(
+                cfg, creator, None, names, ckw, batch=batch))
+        if cfg.get("xhatshuffle"):
+            spokes.append(vanilla.xhatshuffle_spoke(
+                cfg, creator, None, names, ckw, batch=batch))
+        if cfg.get("xhatxbar"):
+            spokes.append(vanilla.xhatxbar_spoke(
+                cfg, creator, None, names, ckw, batch=batch))
+        if cfg.get("xhatspecific"):
+            spokes.append(vanilla.xhatspecific_spoke(
+                cfg, creator, None, names,
+                scenario_creator_kwargs=ckw, batch=batch))
+        if cfg.get("xhatlshaped"):
+            spokes.append(vanilla.xhatlshaped_spoke(
+                cfg, creator, None, names, ckw, batch=batch))
+        if cfg.get("slammax"):
+            spokes.append(vanilla.slammax_spoke(
+                cfg, creator, None, names, ckw, batch=batch))
+        if cfg.get("slammin"):
+            spokes.append(vanilla.slammin_spoke(
+                cfg, creator, None, names, ckw, batch=batch))
+        if cfg.get("fixer"):
+            vanilla.add_fixer(hub, cfg)
+        if cfg.get("use_norm_rho_updater"):
+            vanilla.add_norm_rho(hub, cfg)
+        if cfg.get("mult_rho"):
+            vanilla.add_multi_rho(hub, cfg)
+        if cfg.get("wtracker"):
+            vanilla.add_wtracker(hub, cfg)
+        if cfg.get("W_fname") or cfg.get("Xbar_fname"):
+            from ..extensions.wxbarwriter import WXBarWriter
+            hub["opt_kwargs"]["options"]["W_fname"] = (
+                cfg.get("W_fname") or cfg.get("Xbar_fname"))
+            vanilla.extension_adder(hub, WXBarWriter)
+        if cfg.get("init_W_fname") or cfg.get("init_Xbar_fname"):
+            from ..extensions.wxbarreader import WXBarReader
+            hub["opt_kwargs"]["options"]["init_W_fname"] = (
+                cfg.get("init_W_fname") or cfg.get("init_Xbar_fname"))
+            vanilla.extension_adder(hub, WXBarReader)
+        if cfg.get("primal_dual_converger"):
+            from ..convergers.primal_dual_converger import \
+                PrimalDualConverger
+            hub["opt_kwargs"]["options"]["ph_converger"] = \
+                PrimalDualConverger
+            hub["opt_kwargs"]["options"][
+                "primal_dual_converger_options"] = {
+                "tol": cfg.get("primal_dual_converger_tol", 1e-2)}
+        elif cfg.get("use_norm_rho_converger"):
+            from ..convergers.norm_rho_converger import NormRhoConverger
+            hub["opt_kwargs"]["options"]["ph_converger"] = \
+                NormRhoConverger
+
+        self.wheel = WheelSpinner(hub, spokes).spin()
+        self.best_inner_bound = self.wheel.BestInnerBound
+        self.best_outer_bound = self.wheel.BestOuterBound
+        sol = self.wheel.best_nonant_solution()
+        if sol is not None:
+            self.first_stage_solution = np.asarray(sol)
+        if cfg.get("solution_base_name"):
+            opt = self.wheel.spcomm.opt
+            if self.first_stage_solution is not None:
+                fss = self.first_stage_solution
+                opt.write_first_stage_solution(
+                    cfg["solution_base_name"] + ".csv",
+                    fss[0] if fss.ndim > 1 else fss)
+        return self

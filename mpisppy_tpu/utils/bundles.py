@@ -1,0 +1,110 @@
+"""Bundling — block-diagonal stacking of scenarios into one batch
+element (reference: spopt.py:805-836 subproblem_creation +
+utils/pickle_bundle.py "proper bundles"; SURVEY.md §2.10).
+
+A bundle of m scenarios becomes ONE subproblem: constraint blocks on
+the diagonal, objectives weighted by within-bundle conditional
+probability, and (m-1)*K explicit nonanticipativity equality rows
+chaining the members' nonant columns — the same construction as the
+reference's per-bundle EF (sputils._create_EF_from_scen_dict), done on
+arrays.  The bundled batch is a plain ScenarioBatch, so every
+algorithm (PH, L-shaped, FWPH, EF) runs on bundles unchanged; PH's
+consensus then couples only across bundles.
+
+Two-stage only (proper bundles make multistage 2-stage by construction
+in the reference as well — pickle_bundle.py:14-30).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ir import ScenarioBatch, TreeInfo
+
+INF = float("inf")
+
+
+def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
+    """Stack every `scenarios_per_bundle` consecutive scenarios into a
+    bundle.  S must be divisible by the bundle size (the reference
+    likewise requires equal bundles, spbase.py:219 _assign_bundles)."""
+    m = int(scenarios_per_bundle)
+    S = batch.num_scens
+    if m <= 1:
+        return batch
+    if S % m:
+        raise ValueError(f"num_scens {S} not divisible by bundle size {m}")
+    if int(np.asarray(batch.tree.node_of).max()) > 0:
+        raise ValueError("bundle_batch is two-stage only")
+    B = S // m
+    N, M, K = batch.num_vars, batch.num_rows, batch.num_nonants
+    na = np.asarray(batch.nonant_idx)
+    A = np.asarray(batch.A)
+    prob = np.asarray(batch.prob)
+
+    Nb = m * N
+    Mb = m * M + (m - 1) * K
+    Ab = np.zeros((B, Mb, Nb))
+    lob = np.full((B, Mb), -INF)
+    hib = np.full((B, Mb), INF)
+    cb = np.zeros((B, Nb))
+    qb = np.zeros((B, Nb))
+    lbb = np.zeros((B, Nb))
+    ubb = np.zeros((B, Nb))
+    constb = np.zeros((B,))
+    intb = np.zeros((B, Nb), bool)
+    pb = np.zeros((B,))
+
+    c = np.asarray(batch.c)
+    q = np.asarray(batch.qdiag)
+    lo = np.asarray(batch.row_lo)
+    hi = np.asarray(batch.row_hi)
+    lb = np.asarray(batch.lb)
+    ub = np.asarray(batch.ub)
+    oc = np.asarray(batch.obj_const)
+    im = np.asarray(batch.integer_mask)
+
+    for b in range(B):
+        mem = range(b * m, (b + 1) * m)
+        pB = prob[list(mem)].sum()
+        pb[b] = pB
+        for j, s in enumerate(mem):
+            w = prob[s] / pB if pB > 0 else 1.0 / m
+            sl = slice(j * N, (j + 1) * N)
+            rw = slice(j * M, (j + 1) * M)
+            Ab[b, rw, sl] = A[s]
+            lob[b, rw] = lo[s]
+            hib[b, rw] = hi[s]
+            cb[b, sl] = w * c[s]
+            qb[b, sl] = w * q[s]
+            lbb[b, sl] = lb[s]
+            ubb[b, sl] = ub[s]
+            intb[b, sl] = im[s]
+            constb[b] += w * oc[s]
+        # nonant chains: member j's nonants == member 0's
+        for j in range(1, m):
+            for k in range(K):
+                r = m * M + (j - 1) * K + k
+                Ab[b, r, na[k]] = 1.0
+                Ab[b, r, j * N + na[k]] = -1.0
+                lob[b, r] = 0.0
+                hib[b, r] = 0.0
+
+    names = batch.tree.scen_names or tuple(str(i) for i in range(S))
+    tree = TreeInfo(
+        node_of=np.zeros((B, K), np.int32),
+        prob=pb / pb.sum(),
+        num_nodes=1,
+        stage_of=batch.tree.stage_of,
+        nonant_names=batch.tree.nonant_names,
+        scen_names=tuple(f"bundle{b}({names[b*m]}..{names[(b+1)*m-1]})"
+                         for b in range(B)),
+    )
+    return ScenarioBatch(
+        c=cb, qdiag=qb, A=Ab, row_lo=lob, row_hi=hib, lb=lbb, ub=ubb,
+        obj_const=constb, nonant_idx=batch.nonant_idx,
+        integer_mask=intb, tree=tree,
+        stage_cost_c=None,
+        var_names=tuple(f"m{j}.{v}" for j in range(m)
+                        for v in (batch.var_names
+                                  or tuple(str(i) for i in range(N)))))

@@ -1,0 +1,64 @@
+"""Array-native batch serialization (reference:
+mpisppy/utils/pickle_bundle.py — dill-serialized "proper bundles" to
+skip model build time; SURVEY.md §2.9: "array-native checkpoint of
+lowered tensors").
+
+A ScenarioBatch is a pytree of arrays + static metadata: np.savez holds
+the arrays, a tiny JSON sidecar string holds the metadata.  Round-trips
+through `dill_pickle` / `dill_unpickle` names kept for API parity.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..ir import ScenarioBatch, TreeInfo
+
+
+def dill_pickle(batch: ScenarioBatch, path):
+    """Write a batch to `path` (.npz)."""
+    meta = dict(
+        num_nodes=int(batch.tree.num_nodes),
+        stage_of=list(batch.tree.stage_of or ()),
+        nonant_names=list(batch.tree.nonant_names or ()),
+        scen_names=list(batch.tree.scen_names or ()),
+        var_names=list(batch.var_names or ()),
+        has_stage_cost=batch.stage_cost_c is not None,
+    )
+    arrays = dict(
+        c=np.asarray(batch.c), qdiag=np.asarray(batch.qdiag),
+        A=np.asarray(batch.A), row_lo=np.asarray(batch.row_lo),
+        row_hi=np.asarray(batch.row_hi), lb=np.asarray(batch.lb),
+        ub=np.asarray(batch.ub), obj_const=np.asarray(batch.obj_const),
+        nonant_idx=np.asarray(batch.nonant_idx),
+        integer_mask=np.asarray(batch.integer_mask),
+        node_of=np.asarray(batch.tree.node_of),
+        prob=np.asarray(batch.tree.prob),
+        meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+    )
+    if batch.stage_cost_c is not None:
+        arrays["stage_cost_c"] = np.asarray(batch.stage_cost_c)
+    np.savez_compressed(path, **arrays)
+
+
+def dill_unpickle(path) -> ScenarioBatch:
+    """Read a batch written by dill_pickle."""
+    z = np.load(path)
+    meta = json.loads(bytes(z["meta"]).decode())
+    tree = TreeInfo(
+        node_of=z["node_of"], prob=z["prob"],
+        num_nodes=meta["num_nodes"],
+        stage_of=tuple(meta["stage_of"]) or None,
+        nonant_names=tuple(meta["nonant_names"]),
+        scen_names=tuple(meta["scen_names"]),
+    )
+    return ScenarioBatch(
+        c=z["c"], qdiag=z["qdiag"], A=z["A"], row_lo=z["row_lo"],
+        row_hi=z["row_hi"], lb=z["lb"], ub=z["ub"],
+        obj_const=z["obj_const"], nonant_idx=z["nonant_idx"],
+        integer_mask=z["integer_mask"], tree=tree,
+        stage_cost_c=z["stage_cost_c"] if meta["has_stage_cost"] else None,
+        var_names=tuple(meta["var_names"]),
+    )

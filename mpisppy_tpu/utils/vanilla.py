@@ -1,0 +1,276 @@
+"""vanilla — hub/spoke dict factories from a Config (reference:
+mpisppy/utils/cfg_vanilla.py, 637 LoC).
+
+Each factory returns the dict schema WheelSpinner consumes.  All
+factories share the signature (cfg, scenario_creator,
+scenario_denouement, all_scenario_names, ...) of the reference, plus
+the fast-path `batch=` keyword (a prebuilt ScenarioBatch) that skips
+the per-scenario creator loop.
+"""
+
+from __future__ import annotations
+
+from ..cylinders.fwph_spoke import FrankWolfeOuterBound
+from ..cylinders.hub import APHHub, LShapedHub, PHHub
+from ..cylinders.lagranger_bounder import LagrangerOuterBound
+from ..cylinders.lagrangian_bounder import LagrangianOuterBound
+from ..cylinders.lshaped_bounder import XhatLShapedInnerBound
+from ..cylinders.slam_heuristic import SlamMaxHeuristic, SlamMinHeuristic
+from ..cylinders.xhatlooper_bounder import XhatLooperInnerBound
+from ..cylinders.xhatshufflelooper_bounder import XhatShuffleInnerBound
+from ..cylinders.xhatspecific_bounder import XhatSpecificInnerBound
+from ..cylinders.xhatxbar_bounder import XhatXbarInnerBound
+from ..fwph.fwph import FWPH
+from ..opt.aph import APH
+from ..opt.lshaped import LShapedMethod
+from ..opt.ph import PH
+from ..utils.xhat_eval import Xhat_Eval
+
+
+def shared_options(cfg):
+    return cfg.options_dict()
+
+
+def _opt_kwargs(cfg, scenario_creator, scenario_denouement,
+                all_scenario_names, scenario_creator_kwargs=None,
+                batch=None, rho_setter=None, all_nodenames=None,
+                extensions=None, extension_kwargs=None, extra=None):
+    opts = shared_options(cfg)
+    if extra:
+        opts.update(extra)
+    kw = dict(options=opts,
+              all_scenario_names=all_scenario_names,
+              scenario_creator=scenario_creator,
+              scenario_denouement=scenario_denouement,
+              scenario_creator_kwargs=scenario_creator_kwargs,
+              batch=batch)
+    if rho_setter is not None:
+        kw["rho_setter"] = rho_setter
+    if all_nodenames is not None:
+        kw["all_nodenames"] = all_nodenames
+    if extensions is not None:
+        kw["extensions"] = extensions
+        kw["extension_kwargs"] = extension_kwargs
+    return kw
+
+
+def _hub_options(cfg):
+    o = {}
+    for k in ("rel_gap", "abs_gap", "max_stalled_iters"):
+        if cfg.get(k) is not None:
+            o[k] = cfg[k]
+    o["convthresh"] = cfg.get("convthresh", 1e-4)
+    return o
+
+
+def ph_hub(cfg, scenario_creator, scenario_denouement,
+           all_scenario_names, scenario_creator_kwargs=None,
+           ph_extensions=None, extension_kwargs=None, rho_setter=None,
+           all_nodenames=None, batch=None):
+    """Reference cfg_vanilla.py:77 ph_hub."""
+    return {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": _hub_options(cfg)},
+        "opt_class": PH,
+        "opt_kwargs": _opt_kwargs(
+            cfg, scenario_creator, scenario_denouement,
+            all_scenario_names, scenario_creator_kwargs, batch,
+            rho_setter, all_nodenames, ph_extensions, extension_kwargs),
+    }
+
+
+def aph_hub(cfg, scenario_creator, scenario_denouement,
+            all_scenario_names, scenario_creator_kwargs=None,
+            ph_extensions=None, extension_kwargs=None, rho_setter=None,
+            all_nodenames=None, batch=None):
+    """Reference cfg_vanilla.py:128 aph_hub."""
+    d = ph_hub(cfg, scenario_creator, scenario_denouement,
+               all_scenario_names, scenario_creator_kwargs,
+               ph_extensions, extension_kwargs, rho_setter,
+               all_nodenames, batch)
+    d["hub_class"] = APHHub
+    d["opt_class"] = APH
+    return d
+
+
+def lshaped_hub(cfg, scenario_creator, scenario_denouement,
+                all_scenario_names, scenario_creator_kwargs=None,
+                batch=None):
+    opts = shared_options(cfg)
+    opts.update({"max_iter": cfg.get("max_iterations", 50),
+                 "tol": cfg.get("convthresh", 1e-6)})
+    return {
+        "hub_class": LShapedHub,
+        "hub_kwargs": {"options": _hub_options(cfg)},
+        "opt_class": LShapedMethod,
+        "opt_kwargs": dict(options=opts,
+                           all_scenario_names=all_scenario_names,
+                           scenario_creator=scenario_creator,
+                           scenario_creator_kwargs=scenario_creator_kwargs,
+                           batch=batch),
+    }
+
+
+def _spoke(spoke_class, opt_class, cfg, scenario_creator,
+           scenario_denouement, all_scenario_names,
+           scenario_creator_kwargs=None, batch=None, extra=None,
+           spoke_options=None, all_nodenames=None):
+    return {
+        "spoke_class": spoke_class,
+        "spoke_kwargs": {"options": spoke_options or {}},
+        "opt_class": opt_class,
+        "opt_kwargs": _opt_kwargs(
+            cfg, scenario_creator, scenario_denouement,
+            all_scenario_names, scenario_creator_kwargs, batch,
+            all_nodenames=all_nodenames, extra=extra),
+    }
+
+
+def fwph_spoke(cfg, scenario_creator, scenario_denouement,
+               all_scenario_names, scenario_creator_kwargs=None,
+               batch=None):
+    """Reference cfg_vanilla.py:277."""
+    return _spoke(FrankWolfeOuterBound, FWPH, cfg, scenario_creator,
+                  scenario_denouement, all_scenario_names,
+                  scenario_creator_kwargs, batch)
+
+
+def lagrangian_spoke(cfg, scenario_creator, scenario_denouement,
+                     all_scenario_names, scenario_creator_kwargs=None,
+                     rho_setter=None, batch=None):
+    """Reference cfg_vanilla.py:320."""
+    return _spoke(LagrangianOuterBound, PH, cfg, scenario_creator,
+                  scenario_denouement, all_scenario_names,
+                  scenario_creator_kwargs, batch)
+
+
+def lagranger_spoke(cfg, scenario_creator, scenario_denouement,
+                    all_scenario_names, scenario_creator_kwargs=None,
+                    rho_setter=None, batch=None):
+    """Reference cfg_vanilla.py:356."""
+    extra = {}
+    if cfg.get("lagranger_rho_rescale_factors_json"):
+        import json
+        with open(cfg["lagranger_rho_rescale_factors_json"]) as f:
+            extra["lagranger_rho_rescale_factors"] = {
+                int(k): v for k, v in json.load(f).items()}
+    return _spoke(LagrangerOuterBound, PH, cfg, scenario_creator,
+                  scenario_denouement, all_scenario_names,
+                  scenario_creator_kwargs, batch, extra=extra)
+
+
+def xhatlooper_spoke(cfg, scenario_creator, scenario_denouement,
+                     all_scenario_names, scenario_creator_kwargs=None,
+                     batch=None):
+    """Reference cfg_vanilla.py:393."""
+    return _spoke(XhatLooperInnerBound, Xhat_Eval, cfg,
+                  scenario_creator, scenario_denouement,
+                  all_scenario_names, scenario_creator_kwargs, batch,
+                  spoke_options={"xhat_scen_limit":
+                                 cfg.get("xhat_scen_limit", 3)})
+
+
+def xhatshuffle_spoke(cfg, scenario_creator, scenario_denouement,
+                      all_scenario_names, scenario_creator_kwargs=None,
+                      all_nodenames=None, batch=None):
+    return _spoke(XhatShuffleInnerBound, Xhat_Eval, cfg,
+                  scenario_creator, scenario_denouement,
+                  all_scenario_names, scenario_creator_kwargs, batch,
+                  all_nodenames=all_nodenames,
+                  spoke_options={"add_reversed_shuffle":
+                                 cfg.get("add_reversed_shuffle", False)})
+
+
+def xhatspecific_spoke(cfg, scenario_creator, scenario_denouement,
+                       all_scenario_names, scenario_dict=None,
+                       scenario_creator_kwargs=None, all_nodenames=None,
+                       batch=None):
+    return _spoke(XhatSpecificInnerBound, Xhat_Eval, cfg,
+                  scenario_creator, scenario_denouement,
+                  all_scenario_names, scenario_creator_kwargs, batch,
+                  all_nodenames=all_nodenames,
+                  spoke_options={"xhat_scenario_dict":
+                                 scenario_dict or {}})
+
+
+def xhatxbar_spoke(cfg, scenario_creator, scenario_denouement,
+                   all_scenario_names, scenario_creator_kwargs=None,
+                   batch=None):
+    """Reference cfg_vanilla.py:424."""
+    return _spoke(XhatXbarInnerBound, Xhat_Eval, cfg, scenario_creator,
+                  scenario_denouement, all_scenario_names,
+                  scenario_creator_kwargs, batch)
+
+
+def xhatlshaped_spoke(cfg, scenario_creator, scenario_denouement,
+                      all_scenario_names, scenario_creator_kwargs=None,
+                      batch=None):
+    return _spoke(XhatLShapedInnerBound, Xhat_Eval, cfg,
+                  scenario_creator, scenario_denouement,
+                  all_scenario_names, scenario_creator_kwargs, batch)
+
+
+def slammax_spoke(cfg, scenario_creator, scenario_denouement,
+                  all_scenario_names, scenario_creator_kwargs=None,
+                  batch=None):
+    return _spoke(SlamMaxHeuristic, Xhat_Eval, cfg, scenario_creator,
+                  scenario_denouement, all_scenario_names,
+                  scenario_creator_kwargs, batch)
+
+
+def slammin_spoke(cfg, scenario_creator, scenario_denouement,
+                  all_scenario_names, scenario_creator_kwargs=None,
+                  batch=None):
+    return _spoke(SlamMinHeuristic, Xhat_Eval, cfg, scenario_creator,
+                  scenario_denouement, all_scenario_names,
+                  scenario_creator_kwargs, batch)
+
+
+def extension_adder(hub_dict, ext_class, ext_kwargs=None):
+    """Attach an extension class to a hub dict (reference
+    cfg_vanilla.py:164): promotes to MultiExtension on the second."""
+    from ..extensions import MultiExtension
+    kw = hub_dict["opt_kwargs"]
+    cur = kw.get("extensions")
+    if cur is None:
+        kw["extensions"] = ext_class
+        kw["extension_kwargs"] = ext_kwargs
+    elif cur is MultiExtension:
+        kw["extension_kwargs"]["ext_classes"].append(ext_class)
+    else:
+        kw["extensions"] = MultiExtension
+        kw["extension_kwargs"] = {"ext_classes": [cur, ext_class]}
+    return hub_dict
+
+
+def add_fixer(hub_dict, cfg):
+    """Reference cfg_vanilla.py:184."""
+    from ..extensions.fixer import Fixer
+    hub_dict["opt_kwargs"]["options"]["fixeroptions"] = {
+        "boundtol": cfg.get("fixer_tol", 1e-2),
+        "nb": cfg.get("fixer_nb", 3)}
+    return extension_adder(hub_dict, Fixer)
+
+
+def add_multi_rho(hub_dict, cfg):
+    from ..extensions.mult_rho_updater import MultRhoUpdater
+    hub_dict["opt_kwargs"]["options"]["mult_rho_options"] = {
+        "convergence_tolerance":
+            cfg.get("mult_rho_convergence_tolerance", 1e-4),
+        "rho_update_stop_iteration":
+            cfg.get("mult_rho_update_stop_iteration"),
+        "rho_update_start_iteration":
+            cfg.get("mult_rho_update_start_iteration", 2)}
+    return extension_adder(hub_dict, MultRhoUpdater)
+
+
+def add_norm_rho(hub_dict, cfg):
+    from ..extensions.norm_rho_updater import NormRhoUpdater
+    return extension_adder(hub_dict, NormRhoUpdater)
+
+
+def add_wtracker(hub_dict, cfg):
+    from ..extensions.wtracker_extension import Wtracker_extension
+    hub_dict["opt_kwargs"]["options"]["wtracker_options"] = {
+        "wlen": cfg.get("wtracker_wlen", 10)}
+    return extension_adder(hub_dict, Wtracker_extension)

@@ -1,0 +1,94 @@
+"""W / xbar persistence — PH warm-start checkpointing (reference:
+mpisppy/utils/wxbarutils.py, 594 LoC incl. wxbarwriter/wxbarreader:
+CSVs of W and xbar written each iteration, read at init).
+
+Arrays here: one .npz holds W (S, K) and xbar (S, K) plus the nonant
+names for sanity checks; CSV export/import kept for the reference's
+file format (rows: scenario, varname, value).
+"""
+
+from __future__ import annotations
+
+import csv
+
+import numpy as np
+
+
+def _norm_npz(path):
+    """np.savez appends '.npz' to suffix-less names; normalize so the
+    writer and reader agree on the real filename."""
+    path = str(path)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def write_W_and_xbar(path, opt):
+    """Persist the current PH dual state (reference ROOT usage:
+    WXBarWriter extension)."""
+    st = opt.state
+    np.savez_compressed(
+        _norm_npz(path),
+        W=np.asarray(st.W), xbar=np.asarray(st.xbar),
+        nonant_names=np.array(opt.batch.tree.nonant_names, dtype=object)
+        if opt.batch.tree.nonant_names else np.array([], dtype=object),
+        it=int(st.it))
+
+
+def read_W_and_xbar(path, opt):
+    """Load and install W/xbar into the optimizer's state (after
+    Iter0) — the reference's WXBarReader init path."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    z = np.load(_norm_npz(path), allow_pickle=True)
+    W = np.asarray(z["W"])
+    xbar = np.asarray(z["xbar"])
+    st = opt.state
+    S, K = np.asarray(st.W).shape
+    if W.shape != (S, K) or xbar.shape != (S, K):
+        raise ValueError(
+            f"checkpoint shapes W{W.shape}/xbar{xbar.shape} != "
+            f"current (S,K)=({S},{K})")
+    saved_names = tuple(np.asarray(z["nonant_names"]).tolist())
+    cur_names = tuple(opt.batch.tree.nonant_names or ())
+    if saved_names and cur_names and saved_names != cur_names:
+        raise ValueError(
+            "checkpoint nonant names do not match this model: "
+            f"{saved_names[:3]}... vs {cur_names[:3]}...")
+    dt = np.asarray(st.W).dtype
+    opt.state = dataclasses.replace(
+        st, W=jnp.asarray(W, dt), xbar=jnp.asarray(xbar, dt))
+
+
+def write_W_csv(path, opt):
+    """Reference-format CSV: scenario, varname, W value."""
+    st = opt.state
+    W = np.asarray(st.W)
+    names = opt.batch.tree.nonant_names or tuple(
+        str(k) for k in range(W.shape[1]))
+    scen_names = opt.batch.tree.scen_names or tuple(
+        str(s) for s in range(W.shape[0]))
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        for s in range(min(opt.n_real_scens, W.shape[0])):
+            for k in range(W.shape[1]):
+                w.writerow([scen_names[s], names[k], W[s, k]])
+
+
+def read_W_csv(path, opt):
+    """Read the reference-format CSV back into an (S, K) array."""
+    st = opt.state
+    W = np.array(np.asarray(st.W), copy=True)
+    names = {n: k for k, n in enumerate(
+        opt.batch.tree.nonant_names
+        or tuple(str(k) for k in range(W.shape[1])))}
+    scen = {n: s for s, n in enumerate(
+        opt.batch.tree.scen_names
+        or tuple(str(s) for s in range(W.shape[0])))}
+    with open(path, newline="") as f:
+        for row in csv.reader(f):
+            if len(row) != 3:
+                continue
+            s, k = scen.get(row[0]), names.get(row[1])
+            if s is not None and k is not None:
+                W[s, k] = float(row[2])
+    return W

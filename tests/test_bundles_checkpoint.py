@@ -1,0 +1,97 @@
+"""Bundling + serialization + W/xbar checkpoint tests (reference
+analog: test_ef_ph.py bundle cases, test_pickle_bundle.py,
+test_w_writer.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from efcheck import ef_linprog
+from mpisppy_tpu.extensions.wxbarreader import WXBarReader
+from mpisppy_tpu.extensions.wxbarwriter import WXBarWriter
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.opt.ph import PH
+from mpisppy_tpu.utils.bundles import bundle_batch
+from mpisppy_tpu.utils.pickle_bundle import dill_pickle, dill_unpickle
+from mpisppy_tpu.utils.wxbarutils import read_W_csv, write_W_csv
+
+OPTS = {"defaultPHrho": 1.0, "PHIterLimit": 60, "convthresh": 1e-5,
+        "pdhg_eps": 1e-7}
+
+
+def test_bundled_ef_matches_unbundled():
+    b = farmer.build_batch(6)
+    bb = bundle_batch(b, 2)
+    assert bb.num_scens == 3
+    ref, _ = ef_linprog(b, n_real=6)
+    got, _ = ef_linprog(bb, n_real=3)
+    assert got == pytest.approx(ref, rel=1e-8)
+
+
+def test_bundled_ph_converges_to_same_objective():
+    b = farmer.build_batch(6)
+    bb = bundle_batch(b, 3)
+    ph = PH(OPTS, [f"b{i}" for i in range(2)], batch=bb)
+    conv, eobj, triv = ph.ph_main()
+    ref, _ = ef_linprog(b, n_real=6)
+    assert eobj == pytest.approx(ref, abs=0.01 * abs(ref))
+
+
+def test_bundle_probability_weighting():
+    # NON-UNIFORM scenario probabilities: the within-bundle conditional
+    # weighting (w = p_s / p_B) must reproduce the exact EF value
+    import dataclasses
+
+    from mpisppy_tpu.ir import TreeInfo
+    b = farmer.build_batch(4)
+    p = np.array([0.4, 0.1, 0.3, 0.2])
+    tree = dataclasses.replace(b.tree, prob=p)
+    b = dataclasses.replace(b, tree=tree)
+    bb = bundle_batch(b, 2)
+    pb = np.asarray(bb.prob)
+    assert pb == pytest.approx([0.5, 0.5])
+    ref, _ = ef_linprog(b, n_real=4)
+    got, _ = ef_linprog(bb, n_real=2)
+    assert got == pytest.approx(ref, rel=1e-8)
+
+
+def test_pickle_roundtrip(tmp_path):
+    b = farmer.build_batch(3)
+    path = os.path.join(tmp_path, "farmer3.npz")
+    dill_pickle(b, path)
+    b2 = dill_unpickle(path)
+    assert b2.num_scens == 3
+    assert np.allclose(np.asarray(b.A), np.asarray(b2.A))
+    assert b2.tree.nonant_names == b.tree.nonant_names
+    ref, _ = ef_linprog(b, n_real=3)
+    got, _ = ef_linprog(b2, n_real=3)
+    assert got == pytest.approx(ref)
+
+
+def test_wxbar_checkpoint_roundtrip(tmp_path):
+    path = os.path.join(tmp_path, "wchk.npz")
+    opts = dict(OPTS, PHIterLimit=20, W_fname=path)
+    ph = PH(opts, [f"scen{i}" for i in range(3)],
+            batch=farmer.build_batch(3), extensions=WXBarWriter)
+    ph.ph_main()
+    assert os.path.exists(path)
+    W_end = np.asarray(ph.state.W)
+
+    # warm-started run must pick up where the first left off: its W
+    # right after the reader installs matches the checkpoint
+    opts2 = dict(OPTS, PHIterLimit=1, init_W_fname=path)
+    ph2 = PH(opts2, [f"scen{i}" for i in range(3)],
+             batch=farmer.build_batch(3), extensions=WXBarReader)
+    ph2.Iter0()
+    assert np.allclose(np.asarray(ph2.state.W), W_end)
+
+
+def test_w_csv_roundtrip(tmp_path):
+    path = os.path.join(tmp_path, "w.csv")
+    ph = PH(dict(OPTS, PHIterLimit=3), [f"scen{i}" for i in range(3)],
+            batch=farmer.build_batch(3))
+    ph.ph_main()
+    write_W_csv(path, ph)
+    W = read_W_csv(path, ph)
+    assert np.allclose(W[:3], np.asarray(ph.state.W)[:3])

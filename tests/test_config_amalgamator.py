@@ -1,0 +1,144 @@
+"""Config / vanilla / amalgamator layer tests (reference analog:
+config + cfg_vanilla + amalgamator usage in examples and
+test_ef_ph.py)."""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.models import farmer, hydro
+from mpisppy_tpu.utils import amalgamator, config, vanilla
+
+
+def fresh_cfg():
+    cfg = config.Config()
+    cfg.popular_args()
+    cfg.ph_args()
+    cfg.two_sided_args()
+    return cfg
+
+
+def test_config_declare_and_parse():
+    cfg = fresh_cfg()
+    cfg.add_to_config("my_flag", "test flag", int, 7)
+    cfg.parse_command_line("t", args=["--my-flag", "9",
+                                      "--max-iterations", "12"])
+    assert cfg.my_flag == 9
+    assert cfg.max_iterations == 12
+    assert cfg["default_rho"] == 1.0
+
+
+def test_config_bool_flags():
+    cfg = config.Config()
+    cfg.add_to_config("switch", "bool flag", bool, False)
+    cfg.parse_command_line("t", args=["--switch"])
+    assert cfg.switch is True
+
+
+def test_config_redeclare_no_clobber():
+    cfg = fresh_cfg()
+    cfg["max_iterations"] = 55
+    cfg.popular_args()     # re-declare group must not clobber values
+    assert cfg.max_iterations == 55
+
+
+def test_options_dict_mapping():
+    cfg = fresh_cfg()
+    cfg["max_iterations"] = 5
+    cfg["default_rho"] = 2.5
+    o = cfg.options_dict()
+    assert o["PHIterLimit"] == 5
+    assert o["defaultPHrho"] == 2.5
+
+
+def test_vanilla_wheel_runs():
+    cfg = fresh_cfg()
+    cfg.xhatshuffle_args()
+    cfg.lagrangian_args()
+    cfg["max_iterations"] = 20
+    cfg["rel_gap"] = 1e-3
+    cfg["solver_eps"] = 1e-7
+    names = farmer.scenario_names_creator(3)
+    batch = farmer.build_batch(3)
+    hub = vanilla.ph_hub(cfg, farmer.scenario_creator, None, names,
+                         batch=batch)
+    spokes = [
+        vanilla.lagrangian_spoke(cfg, farmer.scenario_creator, None,
+                                 names, batch=batch),
+        vanilla.xhatshuffle_spoke(cfg, farmer.scenario_creator, None,
+                                  names, batch=batch),
+    ]
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+    ws = WheelSpinner(hub, spokes).spin()
+    assert abs(ws.BestInnerBound - -108390.0) < 100.0
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-4 * abs(
+        ws.BestInnerBound)
+
+
+def test_extension_adder_promotes_to_multi():
+    from mpisppy_tpu.extensions import MultiExtension
+    from mpisppy_tpu.extensions.fixer import Fixer
+    from mpisppy_tpu.extensions.mipgapper import Gapper
+    cfg = fresh_cfg()
+    cfg.fixer_args()
+    names = farmer.scenario_names_creator(3)
+    hub = vanilla.ph_hub(cfg, farmer.scenario_creator, None, names,
+                         batch=farmer.build_batch(3))
+    vanilla.add_fixer(hub, cfg)
+    assert hub["opt_kwargs"]["extensions"] is Fixer
+    vanilla.extension_adder(hub, Gapper)
+    assert hub["opt_kwargs"]["extensions"] is MultiExtension
+    assert Gapper in hub["opt_kwargs"]["extension_kwargs"]["ext_classes"]
+
+
+def test_amalgamator_ef_farmer():
+    cfg = config.Config()
+    cfg.popular_args()
+    cfg.ph_args()
+    cfg.quick_assign("EF", bool, True)
+    cfg.quick_assign("EF_solver_eps", float, 1e-7)
+    ama = amalgamator.from_module(
+        "mpisppy_tpu.models.farmer", cfg, use_command_line=True,
+        args=["--num-scens", "3"])
+    ama.run()
+    assert ama.EF_Obj == pytest.approx(-108390.0, abs=10.0)
+    assert ama.first_stage_solution is not None
+
+
+def test_amalgamator_wheel_farmer():
+    cfg = config.Config()
+    cfg.popular_args()
+    cfg.ph_args()
+    cfg.two_sided_args()
+    cfg.xhatxbar_args()
+    cfg.lagrangian_args()
+    ama = amalgamator.from_module(
+        "mpisppy_tpu.models.farmer", cfg, use_command_line=True,
+        args=["--num-scens", "3", "--xhatxbar", "--lagrangian",
+              "--max-iterations", "20", "--rel-gap", "1e-3",
+              "--solver-eps", "1e-7"])
+    ama.run()
+    assert abs(ama.best_inner_bound - -108390.0) < 100.0
+
+
+def test_amalgamator_multistage_hydro():
+    cfg = config.Config()
+    cfg.popular_args()
+    cfg.ph_args()
+    cfg.quick_assign("EF", bool, True)
+    ama = amalgamator.from_module(
+        "mpisppy_tpu.models.hydro", cfg, use_command_line=True,
+        args=["--branching-factors", "3,3"])
+    ama.run()
+    # reference golden: hydro EF objective ~ 190 at 2 sig figs
+    assert ama.EF_Obj == pytest.approx(190.0, rel=0.05)
+
+
+def test_cli_driver_main():
+    import sys
+    sys.path.insert(0, "examples")
+    import farmer_cylinders
+    ws = farmer_cylinders.main(
+        args=["--num-scens", "3", "--lagrangian", "--xhatxbar",
+              "--max-iterations", "40", "--rel-gap", "1e-3",
+              "--solver-eps", "1e-7"])
+    assert abs(ws.BestInnerBound - -108390.0) < 100.0
