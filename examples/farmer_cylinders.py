@@ -6,8 +6,6 @@ cfg -> vanilla -> WheelSpinner pipeline).
         --xhatshuffle --rel-gap 1e-4 --max-iterations 100
 """
 
-import numpy as np
-
 from mpisppy_tpu.models import farmer
 from mpisppy_tpu.spin_the_wheel import WheelSpinner
 from mpisppy_tpu.utils import config, vanilla
@@ -45,42 +43,15 @@ def main(args=None):
                          batch=batch)
     if cfg.get("fixer"):
         vanilla.add_fixer(hub, cfg)
-    spokes = []
-    if cfg.get("fwph"):
-        spokes.append(vanilla.fwph_spoke(
-            cfg, farmer.scenario_creator, None, names, batch=batch))
-    if cfg.get("lagrangian"):
-        spokes.append(vanilla.lagrangian_spoke(
-            cfg, farmer.scenario_creator, None, names, batch=batch))
-    if cfg.get("lagranger"):
-        spokes.append(vanilla.lagranger_spoke(
-            cfg, farmer.scenario_creator, None, names, batch=batch))
-    if cfg.get("xhatlooper"):
-        spokes.append(vanilla.xhatlooper_spoke(
-            cfg, farmer.scenario_creator, None, names, batch=batch))
-    if cfg.get("xhatshuffle"):
-        spokes.append(vanilla.xhatshuffle_spoke(
-            cfg, farmer.scenario_creator, None, names, batch=batch))
-    if cfg.get("xhatxbar"):
-        spokes.append(vanilla.xhatxbar_spoke(
-            cfg, farmer.scenario_creator, None, names, batch=batch))
-    if cfg.get("slammax"):
-        spokes.append(vanilla.slammax_spoke(
-            cfg, farmer.scenario_creator, None, names, batch=batch))
-    if cfg.get("slammin"):
-        spokes.append(vanilla.slammin_spoke(
-            cfg, farmer.scenario_creator, None, names, batch=batch))
+    spokes = vanilla.build_spokes(cfg, farmer.scenario_creator, None,
+                                  names, batch=batch)
 
     ws = WheelSpinner(hub, spokes).spin()
     print(f"BestInnerBound = {ws.BestInnerBound}")
     print(f"BestOuterBound = {ws.BestOuterBound}")
-    if cfg.get("solution_base_name"):
-        sol = ws.best_nonant_solution()
-        if sol is not None:
-            sol = np.asarray(sol)
-            ws.spcomm.opt.write_first_stage_solution(
-                cfg["solution_base_name"] + ".csv",
-                sol[0] if sol.ndim > 1 else sol)
+    if cfg.get("solution_base_name") and \
+            ws.best_nonant_solution() is not None:
+        ws.write_first_stage_solution(cfg["solution_base_name"] + ".csv")
     return ws
 
 

@@ -1,0 +1,136 @@
+"""ciutils — shared confidence-interval machinery (reference:
+mpisppy/confidence_intervals/ciutils.py, 427 LoC).
+
+Provides seed discipline, xhat (de)serialization, batch sampling
+through the amalgamator module contract, and the central
+`gap_estimators` (reference ciutils.py:208-427): for a candidate xhat
+and a fresh scenario sample, the bias-corrected point estimate G and
+sample standard deviation s of the optimality gap.
+
+Sampling protocol: the model module's build_batch is called with a
+seed-bearing kwarg (`seed` or `seedoffset`, whichever its signature
+takes) so each batch of scenarios is an independent draw — the analog
+of the reference's `scenario_names_creator(n, start=seed)` convention
+where the scenario NUMBER is the random seed.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import numpy as np
+
+from ..opt.ef import ExtensiveForm
+from ..utils.xhat_eval import Xhat_Eval
+
+try:
+    from scipy.stats import t as _t_dist
+    HAVE_SCIPY = True
+except ImportError:                                    # pragma: no cover
+    HAVE_SCIPY = False
+
+
+def t_quantile(confidence_level, dof):
+    """One-sided t quantile (reference uses scipy.stats.t.ppf)."""
+    if HAVE_SCIPY:
+        return float(_t_dist.ppf(confidence_level, dof))
+    return 1.96  # normal fallback
+
+
+# -- xhat (de)serialization (reference ciutils.py:135-165) -----------------
+
+def write_xhat(xhat, path="xhat.npy"):
+    np.save(path, np.asarray(xhat))
+
+
+def read_xhat(path="xhat.npy"):
+    return np.load(path)
+
+
+def writetxt_xhat(xhat, path="xhat.txt"):
+    np.savetxt(path, np.asarray(xhat))
+
+
+def readtxt_xhat(path="xhat.txt"):
+    return np.loadtxt(path)
+
+
+# -- sampling through the module contract ----------------------------------
+
+def sample_batch(module, num_scens, seed, cfg=None, extra_kw=None):
+    """Build a batch of `num_scens` scenarios drawn with `seed`."""
+    kw = dict(module.kw_creator(cfg or {})) if hasattr(
+        module, "kw_creator") else {}
+    kw.pop("num_scens", None)
+    kw.update(extra_kw or {})
+    sig = inspect.signature(module.build_batch)
+    if "seed" in sig.parameters:
+        kw["seed"] = seed
+    elif "seedoffset" in sig.parameters:
+        kw["seedoffset"] = seed
+    elif "start_seed" in sig.parameters:
+        kw["start_seed"] = seed
+    return module.build_batch(num_scens, **kw)
+
+
+def _solver_opts(cfg):
+    cfg = cfg or {}
+    return {"pdhg_eps": cfg.get("solver_eps", 1e-7),
+            "pdhg_max_iters": cfg.get("solver_max_iters", 100000)}
+
+
+# -- the gap estimator (reference ciutils.py:208 gap_estimators) -----------
+
+def gap_estimators(xhat_one, mname_or_module, solving_type="EF_2stage",
+                   scenario_names=None, sample_options=None,
+                   num_scens=None, seed=0, cfg=None, objective_gap=False):
+    """Estimate the optimality gap of candidate `xhat_one` on a fresh
+    sample: returns {"G": point estimate, "std": sample std of the
+    per-scenario gap terms, "zhats": E[f(xhat)], "zstar": sampled EF
+    value, "seed": next seed}.
+
+    Two-stage: G_n = (1/n) sum_s [ f_s(xhat) - f_s(x*_n) ] with x*_n
+    the sampled-EF optimizer — the downward-biased MMW estimator; std
+    is the (n-1)-dof sample std of those terms (reference
+    ciutils.py:208-330).
+    """
+    import importlib
+    m = (importlib.import_module(mname_or_module)
+         if isinstance(mname_or_module, str) else mname_or_module)
+    if num_scens is None:
+        num_scens = len(scenario_names) if scenario_names else 10
+    if solving_type not in ("EF_2stage", "EF-2stage", "EF_mstage"):
+        raise ValueError(f"unknown solving_type {solving_type}")
+
+    batch = sample_batch(m, num_scens, seed, cfg)
+    names = list(batch.tree.scen_names)[:num_scens]
+    opts = _solver_opts(cfg)
+
+    # sampled EF solve -> zstar and the sampled-optimal solution
+    ef = ExtensiveForm(dict(opts), names, batch=batch)
+    res = ef.solve_extensive_form()
+    zstar = ef.get_objective_value()
+    # per-scenario f_s(x*_n): recompute UNWEIGHTED (the consensus solve
+    # reports p_s-weighted objectives, ef.py folds prob into c)
+    fs_star = np.asarray(ef.batch.objective(res.x))[:num_scens]
+
+    # evaluate the candidate on the same sample
+    ev = Xhat_Eval(dict(opts), names, batch=batch)
+    lb, ub = ev.fixed_nonant_bounds(
+        np.asarray(xhat_one), upto_stage=1 if solving_type == "EF_mstage"
+        else None)
+    evres = ev.solve_loop(lb=lb, ub=ub, warm=False)
+    fs_hat = np.asarray(evres.obj)[:num_scens]
+    prob = np.asarray(batch.prob)[:num_scens]
+    prob = prob / prob.sum()
+    zhat = float(prob @ fs_hat)
+
+    gaps = fs_hat - fs_star                       # per-scenario gap terms
+    G = float(prob @ gaps)
+    # classic MMW uses the iid sample std (uniform probabilities)
+    std = float(np.std(gaps, ddof=1)) if num_scens > 1 else 0.0
+    out = {"G": G, "std": std, "zhats": zhat, "zstar": zstar,
+           "seed": seed + num_scens}
+    if objective_gap:
+        out["Gobj"] = zhat - zstar
+    return out

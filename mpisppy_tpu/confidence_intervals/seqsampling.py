@@ -1,0 +1,87 @@
+"""Sequential sampling (reference:
+mpisppy/confidence_intervals/seqsampling.py:110-585 — Bayraksan &
+Morton (BM) and Bayraksan & Pierre-Louis (BPL) stopping rules that
+produce an xhat with a gap guarantee).
+
+Loop (reference :265-330): at iteration k, draw n_k scenarios, solve
+the sampled EF for a candidate xhat_k, estimate (G_k, s_k) on an
+independent sample, and stop when the rule fires:
+    BM :  G_k <= h * s_k + eps
+    BPL:  G_k + t * s_k / sqrt(n_k) <= eps'   (fixed-width)
+growing n_k geometrically otherwise.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import numpy as np
+
+from .. import global_toc
+from ..opt.ef import ExtensiveForm
+from . import ciutils
+
+
+class SeqSampling:
+    def __init__(self, mname, optionsdict, seed=0,
+                 stopping_criterion="BM", solving_type="EF_2stage"):
+        self.module = (mname if not isinstance(mname, str)
+                       else importlib.import_module(mname))
+        self.options = dict(optionsdict or {})
+        self.seed = int(seed)
+        self.stopping_criterion = stopping_criterion
+        self.solving_type = solving_type
+        # rule parameters (reference defaults)
+        self.n0 = int(self.options.get("n0min",
+                                       self.options.get("nn0min", 10)))
+        self.growth = float(self.options.get("growth_factor", 1.5))
+        self.max_iters = int(self.options.get("kf_Gs",
+                             self.options.get("max_seq_iters", 10)))
+        self.h = float(self.options.get("BM_h", 2.0))
+        self.eps = float(self.options.get("BM_eps", 1e-2))
+        self.eps_prime = float(self.options.get("BPL_eps", None)
+                               or self.options.get("eps", 1.0))
+        self.confidence = float(self.options.get("confidence_level",
+                                                 0.95))
+
+    def _candidate(self, n, seed):
+        """Solve a sampled EF -> root xhat (reference run():
+        approximate_solve)."""
+        batch = ciutils.sample_batch(self.module, n, seed, self.options)
+        names = list(batch.tree.scen_names)[:n]
+        ef = ExtensiveForm(
+            {"pdhg_eps": self.options.get("solver_eps", 1e-7),
+             "pdhg_max_iters":
+                 self.options.get("solver_max_iters", 100000)},
+            names, batch=batch)
+        ef.solve_extensive_form()
+        return np.asarray(ef.get_root_solution())
+
+    def run(self):
+        n = self.n0
+        seed = self.seed
+        history = []
+        for k in range(1, self.max_iters + 1):
+            xhat = self._candidate(n, seed)
+            seed += n
+            est = ciutils.gap_estimators(
+                xhat, self.module, solving_type=self.solving_type,
+                num_scens=n, seed=seed, cfg=self.options)
+            seed = est["seed"]
+            G, s = est["G"], est["std"]
+            history.append((n, G, s))
+            if self.stopping_criterion == "BM":
+                stop = G <= self.h * s + self.eps
+            else:   # BPL fixed-width
+                tq = ciutils.t_quantile(self.confidence, max(n - 1, 1))
+                stop = G + tq * s / np.sqrt(n) <= self.eps_prime
+            global_toc(f"SeqSampling iter {k}: n={n} G={G:.6g} "
+                       f"s={s:.6g} stop={stop}")
+            if stop:
+                return {"xhat_one": xhat, "G": G, "std": s,
+                        "num_scens": n, "T": k, "history": history,
+                        "seed": seed}
+            n = int(np.ceil(n * self.growth))
+        return {"xhat_one": xhat, "G": G, "std": s, "num_scens": n,
+                "T": self.max_iters, "history": history, "seed": seed,
+                "stopped": False}

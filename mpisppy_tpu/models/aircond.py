@@ -68,8 +68,17 @@ def build_batch(branching_factors=(3, 2), start_seed=0,
         digits = tree.scen_digits(s)
         dem[s, 0] = stage_demand(1, None, 1)
         for t in range(1, T):
-            dem[s, t] = stage_demand(t + 1, digits[t - 1],
-                                     branching_factors[t - 1])
+            d = stage_demand(t + 1, digits[t - 1],
+                             branching_factors[t - 1])
+            # per-NODE seeded perturbation (same for all scenarios
+            # through the node — resampling trees for CI estimation,
+            # sample_tree.SampleSubtree, needs start_seed to matter)
+            path_idx = 0
+            for j in range(t):
+                path_idx = path_idx * branching_factors[j] + digits[j]
+            rng = np.random.RandomState(
+                (start_seed * 1000003 + t * 9176 + path_idx) % (2**31))
+            dem[s, t] = d * (0.9 + 0.2 * rng.rand())
 
     for t in range(T):
         # I_t - b_t - I_{t-1} + b_{t-1} - p_t - o_t = -d_t (+start inv)
@@ -141,6 +150,8 @@ MULTISTAGE = True
 
 def inparser_adder(cfg):
     cfg.add_branching_factors()
+    # keep the CLI default aligned with build_batch's (3, 2)
+    cfg["branching_factors"] = "3,2"
 
 
 def kw_creator(options):

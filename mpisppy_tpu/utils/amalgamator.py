@@ -67,9 +67,19 @@ class Amalgamator:
         self.wheel = None
 
     def _make_batch_and_names(self):
+        import inspect
         cfg, m = self.cfg, self.module
         kw = dict(m.kw_creator(cfg))
         kw.pop("num_scens", None)   # build_batch takes it positionally
+        # forward --seed through whichever seed kwarg the builder takes
+        # (same protocol as confidence_intervals.ciutils.sample_batch)
+        if hasattr(m, "build_batch"):
+            sig = inspect.signature(m.build_batch)
+            seed = int(cfg.get("seed", 0) or 0)
+            for s in ("seed", "seedoffset", "start_seed"):
+                if s in sig.parameters and s not in kw:
+                    kw[s] = seed
+                    break
         if getattr(m, "MULTISTAGE", False):
             # multistage modules size themselves from branching factors
             batch = m.build_batch(**kw)
@@ -102,38 +112,9 @@ class Amalgamator:
 
         hub = vanilla.ph_hub(cfg, creator, None, names,
                              scenario_creator_kwargs=ckw, batch=batch)
-        spokes = []
-        if cfg.get("fwph"):
-            spokes.append(vanilla.fwph_spoke(cfg, creator, None, names,
-                                             ckw, batch=batch))
-        if cfg.get("lagrangian"):
-            spokes.append(vanilla.lagrangian_spoke(
-                cfg, creator, None, names, ckw, batch=batch))
-        if cfg.get("lagranger"):
-            spokes.append(vanilla.lagranger_spoke(
-                cfg, creator, None, names, ckw, batch=batch))
-        if cfg.get("xhatlooper"):
-            spokes.append(vanilla.xhatlooper_spoke(
-                cfg, creator, None, names, ckw, batch=batch))
-        if cfg.get("xhatshuffle"):
-            spokes.append(vanilla.xhatshuffle_spoke(
-                cfg, creator, None, names, ckw, batch=batch))
-        if cfg.get("xhatxbar"):
-            spokes.append(vanilla.xhatxbar_spoke(
-                cfg, creator, None, names, ckw, batch=batch))
-        if cfg.get("xhatspecific"):
-            spokes.append(vanilla.xhatspecific_spoke(
-                cfg, creator, None, names,
-                scenario_creator_kwargs=ckw, batch=batch))
-        if cfg.get("xhatlshaped"):
-            spokes.append(vanilla.xhatlshaped_spoke(
-                cfg, creator, None, names, ckw, batch=batch))
-        if cfg.get("slammax"):
-            spokes.append(vanilla.slammax_spoke(
-                cfg, creator, None, names, ckw, batch=batch))
-        if cfg.get("slammin"):
-            spokes.append(vanilla.slammin_spoke(
-                cfg, creator, None, names, ckw, batch=batch))
+        spokes = vanilla.build_spokes(cfg, creator, None, names,
+                                      scenario_creator_kwargs=ckw,
+                                      batch=batch)
         if cfg.get("fixer"):
             vanilla.add_fixer(hub, cfg)
         if cfg.get("use_norm_rho_updater"):
@@ -171,11 +152,8 @@ class Amalgamator:
         sol = self.wheel.best_nonant_solution()
         if sol is not None:
             self.first_stage_solution = np.asarray(sol)
-        if cfg.get("solution_base_name"):
-            opt = self.wheel.spcomm.opt
-            if self.first_stage_solution is not None:
-                fss = self.first_stage_solution
-                opt.write_first_stage_solution(
-                    cfg["solution_base_name"] + ".csv",
-                    fss[0] if fss.ndim > 1 else fss)
+        if cfg.get("solution_base_name") and \
+                self.first_stage_solution is not None:
+            self.wheel.write_first_stage_solution(
+                cfg["solution_base_name"] + ".csv")
         return self

@@ -75,14 +75,12 @@ class Config(dict):
             # absent flag
             cur = self.get(name, d["default"])
             if dom is bool:
-                if cur:
-                    parser.add_argument(
-                        flag, dest=name, type=_boolify,
-                        default=True, help=d["description"])
-                else:
-                    parser.add_argument(
-                        flag, dest=name, action="store_true",
-                        default=False, help=d["description"])
+                # bare --flag means True; --flag false/0 also accepted;
+                # one arity regardless of the current value
+                parser.add_argument(
+                    flag, dest=name, nargs="?", const=True,
+                    type=_boolify, default=bool(cur),
+                    help=d["description"])
             else:
                 parser.add_argument(flag, dest=name, type=dom,
                                     default=cur,

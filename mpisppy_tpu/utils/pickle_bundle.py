@@ -40,12 +40,19 @@ def dill_pickle(batch: ScenarioBatch, path):
     )
     if batch.stage_cost_c is not None:
         arrays["stage_cost_c"] = np.asarray(batch.stage_cost_c)
-    np.savez_compressed(path, **arrays)
+    np.savez_compressed(_norm_npz(path), **arrays)
+
+
+def _norm_npz(path):
+    """np.savez appends '.npz' to suffix-less names; keep reader and
+    writer agreeing (same rule as wxbarutils)."""
+    path = str(path)
+    return path if path.endswith(".npz") else path + ".npz"
 
 
 def dill_unpickle(path) -> ScenarioBatch:
     """Read a batch written by dill_pickle."""
-    z = np.load(path)
+    z = np.load(_norm_npz(path))
     meta = json.loads(bytes(z["meta"]).decode())
     tree = TreeInfo(
         node_of=z["node_of"], prob=z["prob"],

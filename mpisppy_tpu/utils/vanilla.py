@@ -166,7 +166,7 @@ def xhatlooper_spoke(cfg, scenario_creator, scenario_denouement,
     return _spoke(XhatLooperInnerBound, Xhat_Eval, cfg,
                   scenario_creator, scenario_denouement,
                   all_scenario_names, scenario_creator_kwargs, batch,
-                  spoke_options={"xhat_scen_limit":
+                  spoke_options={"scen_limit":
                                  cfg.get("xhat_scen_limit", 3)})
 
 
@@ -177,7 +177,7 @@ def xhatshuffle_spoke(cfg, scenario_creator, scenario_denouement,
                   scenario_creator, scenario_denouement,
                   all_scenario_names, scenario_creator_kwargs, batch,
                   all_nodenames=all_nodenames,
-                  spoke_options={"add_reversed_shuffle":
+                  spoke_options={"reverse":
                                  cfg.get("add_reversed_shuffle", False)})
 
 
@@ -224,6 +224,69 @@ def slammin_spoke(cfg, scenario_creator, scenario_denouement,
     return _spoke(SlamMinHeuristic, Xhat_Eval, cfg, scenario_creator,
                   scenario_denouement, all_scenario_names,
                   scenario_creator_kwargs, batch)
+
+
+def build_spokes(cfg, scenario_creator, scenario_denouement,
+                 all_scenario_names, scenario_creator_kwargs=None,
+                 batch=None, all_nodenames=None, scenario_dict=None):
+    """Flag-driven spoke list — the single home of the cfg-flag ->
+    factory dispatch (shared by Amalgamator and example drivers)."""
+    sk = scenario_creator_kwargs
+    spokes = []
+    if cfg.get("fwph"):
+        spokes.append(fwph_spoke(cfg, scenario_creator,
+                                 scenario_denouement,
+                                 all_scenario_names, sk, batch=batch))
+    if cfg.get("lagrangian"):
+        spokes.append(lagrangian_spoke(cfg, scenario_creator,
+                                       scenario_denouement,
+                                       all_scenario_names, sk,
+                                       batch=batch))
+    if cfg.get("lagranger"):
+        spokes.append(lagranger_spoke(cfg, scenario_creator,
+                                      scenario_denouement,
+                                      all_scenario_names, sk,
+                                      batch=batch))
+    if cfg.get("xhatlooper"):
+        spokes.append(xhatlooper_spoke(cfg, scenario_creator,
+                                       scenario_denouement,
+                                       all_scenario_names, sk,
+                                       batch=batch))
+    if cfg.get("xhatshuffle"):
+        spokes.append(xhatshuffle_spoke(cfg, scenario_creator,
+                                        scenario_denouement,
+                                        all_scenario_names, sk,
+                                        all_nodenames=all_nodenames,
+                                        batch=batch))
+    if cfg.get("xhatspecific"):
+        spokes.append(xhatspecific_spoke(cfg, scenario_creator,
+                                         scenario_denouement,
+                                         all_scenario_names,
+                                         scenario_dict=scenario_dict,
+                                         scenario_creator_kwargs=sk,
+                                         all_nodenames=all_nodenames,
+                                         batch=batch))
+    if cfg.get("xhatxbar"):
+        spokes.append(xhatxbar_spoke(cfg, scenario_creator,
+                                     scenario_denouement,
+                                     all_scenario_names, sk,
+                                     batch=batch))
+    if cfg.get("xhatlshaped"):
+        spokes.append(xhatlshaped_spoke(cfg, scenario_creator,
+                                        scenario_denouement,
+                                        all_scenario_names, sk,
+                                        batch=batch))
+    if cfg.get("slammax"):
+        spokes.append(slammax_spoke(cfg, scenario_creator,
+                                    scenario_denouement,
+                                    all_scenario_names, sk,
+                                    batch=batch))
+    if cfg.get("slammin"):
+        spokes.append(slammin_spoke(cfg, scenario_creator,
+                                    scenario_denouement,
+                                    all_scenario_names, sk,
+                                    batch=batch))
+    return spokes
 
 
 def extension_adder(hub_dict, ext_class, ext_kwargs=None):

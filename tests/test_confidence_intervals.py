@@ -1,0 +1,123 @@
+"""Confidence-interval layer tests (reference analog:
+mpisppy/tests/test_conf_int_farmer.py + test_conf_int_aircond.py)."""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.confidence_intervals import ciutils
+from mpisppy_tpu.confidence_intervals.mmw_ci import MMWConfidenceIntervals
+from mpisppy_tpu.confidence_intervals.multi_seqsampling import (
+    IndepScens_SeqSampling,
+)
+from mpisppy_tpu.confidence_intervals.sample_tree import SampleSubtree
+from mpisppy_tpu.confidence_intervals.seqsampling import SeqSampling
+from mpisppy_tpu.confidence_intervals.zhat4xhat import zhat4xhat
+from mpisppy_tpu.models import aircond, farmer
+
+XHAT_STAR = np.array([170.0, 80.0, 250.0])   # farmer optimum
+OPTS = {"solver_eps": 1e-7}
+
+
+def test_sample_batch_seeds_differ():
+    b1 = ciutils.sample_batch(farmer, 5, seed=100)
+    b2 = ciutils.sample_batch(farmer, 5, seed=200)
+    # different seeds -> different yields (scenarios >= 3 perturb)
+    assert not np.allclose(np.asarray(b1.A), np.asarray(b2.A))
+
+
+def test_gap_estimator_at_optimum_small():
+    est = ciutils.gap_estimators(XHAT_STAR, farmer, num_scens=20,
+                                 seed=500, cfg=OPTS)
+    # the true optimum's gap on a sample is small relative to |z| and
+    # nonnegative up to solver tolerance
+    assert est["G"] >= -1.0
+    assert est["G"] < 0.02 * abs(est["zstar"])
+    assert est["std"] >= 0.0
+    assert est["seed"] == 520
+
+
+def test_gap_estimator_bad_candidate_positive():
+    bad = np.array([500.0, 0.0, 0.0])
+    est = ciutils.gap_estimators(bad, farmer, num_scens=15, seed=700,
+                                 cfg=OPTS)
+    good = ciutils.gap_estimators(XHAT_STAR, farmer, num_scens=15,
+                                  seed=700, cfg=OPTS)
+    assert est["G"] > good["G"] + 100.0   # clearly worse candidate
+
+
+def test_mmw_interval():
+    mmw = MMWConfidenceIntervals(farmer, dict(OPTS), XHAT_STAR,
+                                 num_batches=3, batch_size=10,
+                                 start=1000, mname_is_module=True)
+    r = mmw.run(confidence_level=0.95)
+    assert r["gap_inner_bound"] >= 0.0
+    # at the optimum the gap CI must be tight relative to |z| ~ 1e5
+    assert r["gap_inner_bound"] < 0.05 * abs(r["zstar_bar"])
+    assert len(r["Glist"]) == 3
+
+
+def test_seqsampling_bm_farmer():
+    ss = SeqSampling(farmer, {"BM_h": 2.0, "BM_eps": 500.0,
+                              "n0min": 10, "max_seq_iters": 5,
+                              **OPTS}, seed=42,
+                     stopping_criterion="BM")
+    r = ss.run()
+    assert "xhat_one" in r
+    assert r["xhat_one"].shape == (3,)
+    # the sampled-EF candidate should be close to the true optimum
+    assert abs(r["xhat_one"][2] - 250.0) < 60.0
+
+
+def test_seqsampling_bpl_farmer():
+    ss = SeqSampling(farmer, {"BPL_eps": 2000.0, "n0min": 10,
+                              "max_seq_iters": 4, **OPTS},
+                     seed=99, stopping_criterion="BPL")
+    r = ss.run()
+    assert r["num_scens"] >= 10
+
+
+def test_xhat_io_roundtrip(tmp_path):
+    import os
+    p = os.path.join(tmp_path, "xhat.npy")
+    ciutils.write_xhat(XHAT_STAR, p)
+    assert np.allclose(ciutils.read_xhat(p), XHAT_STAR)
+    pt = os.path.join(tmp_path, "xhat.txt")
+    ciutils.writetxt_xhat(XHAT_STAR, pt)
+    assert np.allclose(ciutils.readtxt_xhat(pt), XHAT_STAR)
+
+
+def test_zhat4xhat_farmer():
+    zbar, s, (lo, hi) = zhat4xhat(farmer, XHAT_STAR, num_samples=4,
+                                  sample_size=8, seed=300,
+                                  options=OPTS)
+    assert lo <= zbar <= hi
+    # z(xhat*) on perturbed-yield samples stays in the right region
+    assert -130000 < zbar < -90000
+
+
+def test_sample_subtree_aircond():
+    b = aircond.build_batch(branching_factors=(2, 2))
+    stage_of = np.asarray(b.tree.stage_of)
+    # candidate: stage-1 decisions from the EF of the nominal tree
+    from mpisppy_tpu.opt.ef import ExtensiveForm
+    ef = ExtensiveForm({"pdhg_eps": 1e-7},
+                       list(b.tree.scen_names), batch=b)
+    ef.solve_extensive_form()
+    xhat = np.asarray(ef.get_root_solution())
+    st = SampleSubtree(aircond, xhat, starting_stage=1,
+                       branching_factors=[2, 2], seed=17, options={})
+    eobj, feas = st.run()
+    assert feas
+    assert eobj > 0
+
+
+def test_indepscens_seqsampling_aircond():
+    ss = IndepScens_SeqSampling(
+        aircond,
+        {"branching_factors": [2, 2], "BM_h": 3.0, "BM_eps": 100.0,
+         "n0min": 4, "max_seq_iters": 3, "num_eval_samples": 2,
+         **OPTS},
+        seed=5, stopping_criterion="BM")
+    r = ss.run()
+    assert "xhat_one" in r and r["xhat_one"] is not None
+    assert np.isfinite(r["G"])
