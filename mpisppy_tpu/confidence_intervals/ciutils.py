@@ -58,7 +58,9 @@ def readtxt_xhat(path="xhat.txt"):
 # -- sampling through the module contract ----------------------------------
 
 def sample_batch(module, num_scens, seed, cfg=None, extra_kw=None):
-    """Build a batch of `num_scens` scenarios drawn with `seed`."""
+    """Build a batch of scenarios drawn with `seed`.  For MULTISTAGE
+    modules, build_batch's first argument is branching_factors (from
+    kw_creator), not a scenario count — num_scens is ignored there."""
     kw = dict(module.kw_creator(cfg or {})) if hasattr(
         module, "kw_creator") else {}
     kw.pop("num_scens", None)
@@ -70,6 +72,8 @@ def sample_batch(module, num_scens, seed, cfg=None, extra_kw=None):
         kw["seedoffset"] = seed
     elif "start_seed" in sig.parameters:
         kw["start_seed"] = seed
+    if getattr(module, "MULTISTAGE", False):
+        return module.build_batch(**kw)
     return module.build_batch(num_scens, **kw)
 
 
@@ -103,6 +107,7 @@ def gap_estimators(xhat_one, mname_or_module, solving_type="EF_2stage",
         raise ValueError(f"unknown solving_type {solving_type}")
 
     batch = sample_batch(m, num_scens, seed, cfg)
+    num_scens = min(num_scens, batch.num_scens)   # multistage trees
     names = list(batch.tree.scen_names)[:num_scens]
     opts = _solver_opts(cfg)
 
@@ -120,6 +125,12 @@ def gap_estimators(xhat_one, mname_or_module, solving_type="EF_2stage",
         np.asarray(xhat_one), upto_stage=1 if solving_type == "EF_mstage"
         else None)
     evres = ev.solve_loop(lb=lb, ub=ub, warm=False)
+    # an infeasible candidate's objectives are junk — fail loudly (the
+    # reference checks solver status and raises)
+    if ev.feas_prob(evres) < 1.0 - 1e-6:
+        raise RuntimeError(
+            "gap_estimators: candidate xhat infeasible on the sample "
+            f"(feasible mass {ev.feas_prob(evres):.4f})")
     fs_hat = np.asarray(evres.obj)[:num_scens]
     prob = np.asarray(batch.prob)[:num_scens]
     prob = prob / prob.sum()

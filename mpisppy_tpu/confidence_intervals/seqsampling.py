@@ -39,8 +39,10 @@ class SeqSampling:
                              self.options.get("max_seq_iters", 10)))
         self.h = float(self.options.get("BM_h", 2.0))
         self.eps = float(self.options.get("BM_eps", 1e-2))
-        self.eps_prime = float(self.options.get("BPL_eps", None)
-                               or self.options.get("eps", 1.0))
+        eps_prime = self.options.get("BPL_eps")
+        if eps_prime is None:
+            eps_prime = self.options.get("eps")
+        self.eps_prime = float(1.0 if eps_prime is None else eps_prime)
         self.confidence = float(self.options.get("confidence_level",
                                                  0.95))
 

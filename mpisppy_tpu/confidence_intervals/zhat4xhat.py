@@ -23,6 +23,9 @@ def evaluate_sample(module, xhat, num_scens, seed, options=None):
         {"pdhg_eps": (options or {}).get("solver_eps", 1e-7)},
         names, batch=batch)
     eobj, feas = ev.evaluate(np.asarray(xhat))
+    if not feas:
+        raise RuntimeError(
+            "zhat4xhat: candidate infeasible on the sampled batch")
     return eobj
 
 

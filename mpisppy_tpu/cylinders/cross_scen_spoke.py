@@ -1,0 +1,63 @@
+"""Cross-scenario cut spoke (reference:
+mpisppy/cylinders/cross_scen_spoke.py:45-296).
+
+Receives the hub's nonant candidate, solves every scenario with
+nonants pinned (one batched call), and ships back an AGGREGATE
+optimality cut of the expected value function at that candidate:
+
+    E[f](x)  >=  Eq + Egrad . (x_na - xhat)
+
+where Eq = sum_s p_s q_s(xhat) and Egrad = sum_s p_s dq_s/dxhat (the
+reduced costs at the pinned slots — free from the first-order solver,
+SURVEY.md §2.9).  The reference ships an (nscen x (nonants+2)) per-
+scenario coefficient matrix; on TPU the aggregation happens spoke-side
+(one psum) and the hub-side extension installs one cut per pass.
+
+Wire format to hub: [Eq | Egrad (K,) | xhat (K,)] (length 2K+1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .spoke import ConvergerSpokeType, _BoundNonantSpoke
+
+
+class CrossScenarioCutSpoke(_BoundNonantSpoke):
+    converger_spoke_types = (ConvergerSpokeType.NONANT_GETTER,)
+    converger_spoke_char = "C"
+    provides_cuts = True      # hub auto-wires attach_spoke extensions
+
+    def send_length(self):
+        K = self.opt.batch.num_nonants
+        return 2 * K + 1
+
+    def step(self):
+        nonants, is_new = self.fresh_nonants()
+        if self._killed or not is_new:
+            return False
+        b = self.opt.batch
+        S = self.opt.n_real_scens
+        K = b.num_nonants
+        # candidate = prob-weighted average of the hub's per-scenario
+        # nonants (they agree at consensus; early on this is xbar)
+        p = np.asarray(b.prob)[:, None]
+        xhat = (p * np.asarray(nonants)).sum(axis=0) / p.sum()
+
+        lb, ub = self.opt.fixed_nonant_bounds(jnp.asarray(xhat))
+        res = self.opt.solve_loop(lb=lb, ub=ub, warm=True)
+        q = np.asarray(res.obj)[:S]
+        aty = jnp.einsum("smn,sm->sn", b.A, res.y)
+        rc = np.asarray(b.c + b.qdiag * res.x + aty)[:S]
+        grad = rc[:, np.asarray(b.nonant_idx)]
+        pr = np.asarray(b.prob)[:S]
+        pr = pr / pr.sum()
+        Eq = float(pr @ q)
+        Egrad = pr @ grad
+        self.spoke_to_hub(np.concatenate([[Eq], Egrad, xhat]))
+        return True
+
+    def finalize(self):
+        return None

@@ -62,13 +62,27 @@ class Hub(SPCommunicator):
                 elif cst == ConvergerSpokeType.NONANT_GETTER:
                     self.nonant_idx_set.add(i)
             self.spoke_chars[i] = sp.converger_spoke_char
-            pair = WindowPair(hub_length=sp.receive_length(),
-                              spoke_length=sp.send_length())
+            pair = WindowPair(
+                hub_length=sp.receive_length(),
+                spoke_length=sp.send_length(),
+                backend=self.options.get("window_backend", "python"))
             sp.pair = pair
             self.pairs.append(pair)
         self._spoke_read_ids = np.zeros(len(self.spokes), np.int64)
         self.has_outerbound_spokes = bool(self.outerbound_idx)
         self.has_innerbound_spokes = bool(self.innerbound_idx)
+        # auto-wire extensions that consume a spoke's feed (the
+        # cross-scenario cut extension reads its spoke's window)
+        ext = getattr(self.opt, "extobject", None)
+        if ext is not None:
+            targets = [ext] + list(getattr(ext, "extensions", []))
+            for e in targets:
+                if hasattr(e, "attach_spoke"):
+                    for sp in self.spokes:
+                        # spokes advertise a feed via this class attr
+                        # (CrossScenarioCutSpoke and subclasses)
+                        if getattr(sp, "provides_cuts", False):
+                            e.attach_spoke(sp)
 
     # -- gap machinery (reference hub.py:77-161) --------------------------
     def compute_gaps(self):

@@ -73,11 +73,28 @@ class WindowPair:
     """The two windows of one hub<->spoke stratum: hub-owned (spoke
     reads) and spoke-owned (hub reads) — the analog of the two
     MPI.Win.Allocate buffers per pair (reference spcommunicator.py:93).
+
+    backend="native" uses the C++ seqlock exchange
+    (runtime/exchange.cpp): identical contract, lock-free reads, and
+    mmap-file support for cross-process (DCN gateway) pairs via
+    `path_prefix`.
     """
 
-    def __init__(self, hub_length: int, spoke_length: int):
-        self.to_spoke = Window(hub_length)
-        self.to_hub = Window(spoke_length)
+    def __init__(self, hub_length: int, spoke_length: int,
+                 backend: str = "python", path_prefix: str | None = None):
+        if backend == "native":
+            from ..runtime import NativeWindow
+            pth = (lambda tag: None if path_prefix is None
+                   else f"{path_prefix}.{tag}")
+            # the pair's creator OWNS the windows: reset any stale file
+            # (leftover kill flag / write_id from a previous run)
+            self.to_spoke = NativeWindow(hub_length, path=pth("to_spoke"),
+                                         reset=True)
+            self.to_hub = NativeWindow(spoke_length, path=pth("to_hub"),
+                                       reset=True)
+        else:
+            self.to_spoke = Window(hub_length)
+            self.to_hub = Window(spoke_length)
 
 
 class SPCommunicator:

@@ -1,0 +1,108 @@
+"""CrossScenarioExtension — hub-side half of cross-scenario cuts
+(reference: mpisppy/extensions/cross_scen_extension.py:16-283).
+
+Requires the hub optimizer to be built over a batch augmented with
+`utils.cross_scenario.add_cross_scenario_capacity` (an epigraph
+variable `eta` approximating E[f](x) plus a buffer of inactive cut
+rows; each scenario's objective is blended
+(1-w) f_s + w eta, which equals E[f] at consensus with tight cuts).
+
+Each sync, the extension drains the CrossScenarioCutSpoke's window and
+installs the aggregate cut
+
+    eta - Egrad . x_na >= Eq - Egrad . xhat
+
+into the next free cut row of EVERY scenario, then re-prepares the
+constraint data (same shapes — no recompilation; the PH superstep
+takes prep as a traced argument).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import global_toc
+from ..ops.pdhg import prepare_batch
+from .extension import Extension
+
+
+class CrossScenarioExtension(Extension):
+    def __init__(self, ph):
+        super().__init__(ph)
+        if not getattr(ph.batch, "var_names", ()) or \
+                ph.batch.var_names[-1] != "_eta_cross":
+            raise RuntimeError(
+                "CrossScenarioExtension needs a batch augmented by "
+                "add_cross_scenario_capacity (eta column missing)")
+        self._spoke = None          # wired via attach_spoke
+        self._read_id = 0
+        self.n_cuts = 0
+
+    def attach_spoke(self, spoke):
+        self._spoke = spoke
+
+    def post_iter0(self):
+        """Seed eta with a VALID constant cut so early bounds aren't
+        polluted by eta's -BIG box (the reference initializes eta with
+        a computed valid lower bound): one W-free solve of the BASE
+        objective gives the wait-and-see bound WS <= min E[f], and
+        eta >= WS is valid everywhere.  Also repairs the trivial bound
+        the blended Iter0 computed."""
+        from ..utils.cross_scenario import cross_meta
+        opt = self.opt
+        b = opt.batch
+        meta = cross_meta(b)
+        # the eta column's objective coefficient IS the blend weight w;
+        # base c = c_blend/(1-w) with the eta column zeroed
+        w = float(np.asarray(b.c)[0, meta["eta_col"]])
+        c_base = np.array(np.asarray(b.c)) / max(1.0 - w, 1e-12)
+        c_base[:, meta["eta_col"]] = 0.0
+        res = opt.solver.solve(opt.prep, jnp.asarray(c_base),
+                               b.qdiag, b.lb, b.ub,
+                               obj_const=b.obj_const / max(1.0 - w, 1e-12))
+        ws = float(jnp.sum(b.prob * res.dual_obj))
+        self._install_cut(ws, np.zeros(b.num_nonants),
+                          np.zeros(b.num_nonants))
+        opt.trivial_bound = ws
+        opt.best_bound = ws
+
+    def _install_cut(self, Eq, Egrad, xhat):
+        from ..utils.cross_scenario import cross_meta
+        opt = self.opt
+        b = opt.batch
+        N = b.num_vars            # eta is column N-1
+        meta = cross_meta(b)
+        if self.n_cuts >= meta["max_cuts"]:
+            global_toc("CrossScenario: cut buffer full; skipping")
+            return
+        r = meta["first_cut_row"] + self.n_cuts
+        na = np.asarray(b.nonant_idx)
+        Arow = np.zeros(N)
+        Arow[na] = -np.asarray(Egrad)
+        Arow[N - 1] = 1.0
+        A = np.array(np.asarray(b.A))
+        A[:, r, :] = Arow
+        lo = np.array(np.asarray(b.row_lo))
+        lo[:, r] = Eq - float(np.asarray(Egrad) @ np.asarray(xhat))
+        opt.batch = dataclasses.replace(
+            b, A=jnp.asarray(A), row_lo=jnp.asarray(lo))
+        opt.prep = prepare_batch(opt.batch.A, opt.batch.row_lo,
+                                 opt.batch.row_hi)
+        self.n_cuts += 1
+
+    def miditer(self):
+        if self._spoke is None or self._spoke.pair is None:
+            return
+        data, wid = self._spoke.pair.to_hub.read()
+        if wid <= self._read_id or wid < 0:
+            return
+        self._read_id = wid
+        K = self.opt.batch.num_nonants
+        Eq = float(data[0])
+        Egrad = np.asarray(data[1:1 + K])
+        xhat = np.asarray(data[1 + K:1 + 2 * K])
+        self._install_cut(Eq, Egrad, xhat)

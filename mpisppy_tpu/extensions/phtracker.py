@@ -1,0 +1,85 @@
+"""PHTracker — per-iteration tracking to CSVs (reference:
+mpisppy/extensions/phtracker.py:14-510: bounds, gaps, xbars, duals,
+nonants, scenario costs as pandas DataFrames in per-cylinder folders).
+
+Options under options["phtracker_options"]:
+    results_folder (default "phtracker_results")
+    track_bounds / track_xbars / track_duals / track_nonants /
+    track_scen_costs (all default True)
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from .extension import Extension
+
+
+class PHTracker(Extension):
+    def __init__(self, ph):
+        super().__init__(ph)
+        o = ph.options.get("phtracker_options") or {}
+        self.folder = o.get("results_folder", "phtracker_results")
+        self.track = {k: bool(o.get(f"track_{k}", True))
+                      for k in ("bounds", "xbars", "duals", "nonants",
+                                "scen_costs")}
+        os.makedirs(self.folder, exist_ok=True)
+        self._files = {}
+
+    def _w(self, name, header):
+        if name not in self._files:
+            path = os.path.join(self.folder, f"{name}.csv")
+            # one file per run ("w"): appending across runs would
+            # interleave iteration rows from different runs
+            f = open(path, "w", newline="")
+            w = csv.writer(f)
+            w.writerow(header)
+            self._files[name] = (f, w)
+        return self._files[name][1]
+
+    def _iteration_row(self):
+        opt = self.opt
+        st = opt.state
+        it = int(st.it)
+        K = opt.batch.num_nonants
+        if self.track["bounds"]:
+            hub = getattr(opt, "spcomm", None)
+            ob = getattr(hub, "BestOuterBound", float("nan"))
+            ib = getattr(hub, "BestInnerBound", float("nan"))
+            conv = float(st.conv)
+            self._w("bounds", ["iteration", "outer", "inner", "conv"]
+                    ).writerow([it, ob, ib, conv])
+        if self.track["xbars"]:
+            self._w("xbars", ["iteration"] + [f"x{k}" for k in range(K)]
+                    ).writerow([it] + np.asarray(st.xbar[0]).tolist())
+        if self.track["duals"]:
+            Wbar = np.abs(np.asarray(st.W)).mean(axis=0)
+            self._w("duals", ["iteration"] + [f"W{k}" for k in range(K)]
+                    ).writerow([it] + Wbar.tolist())
+        if self.track["nonants"]:
+            x_na = np.asarray(opt.batch.nonants(st.x))
+            row = [it] + x_na[: opt.n_real_scens].reshape(-1).tolist()
+            self._w("nonants", ["iteration"] + [
+                f"s{s}_x{k}" for s in range(opt.n_real_scens)
+                for k in range(K)]).writerow(row)
+        if self.track["scen_costs"]:
+            obj = np.asarray(st.obj)[: opt.n_real_scens]
+            self._w("scen_costs", ["iteration"] + [
+                f"s{s}" for s in range(opt.n_real_scens)]
+                ).writerow([it] + obj.tolist())
+        for f, _ in self._files.values():
+            f.flush()
+
+    def post_iter0(self):
+        self._iteration_row()
+
+    def enditer(self):
+        self._iteration_row()
+
+    def post_everything(self):
+        for f, _ in self._files.values():
+            f.close()
+        self._files = {}

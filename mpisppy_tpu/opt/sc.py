@@ -1,0 +1,197 @@
+"""SchurComplement — structured interior-point solve of the two-stage
+EF (reference: mpisppy/opt/sc.py:89-106, which delegates to external
+parapint + MA27: per-scenario KKT factorizations and an MPI-assembled
+Schur complement on the first-stage block; continuous problems only,
+sc.py:18-21).
+
+TPU-native replacement (SURVEY.md §2.9: "batched Cholesky/CG on TPU
+for per-scenario KKT blocks; Schur complement assembled with psum"):
+
+A primal-dual log-barrier IPM on the consensus EF.  Per scenario s the
+barrier Newton step reduces (normal-equations form) to an N x N SPD
+system  M_s = H_mu,s + A_s^T D_s A_s ; splitting columns into the
+shared first-stage block x (the nonant slots) and the local recourse
+block y_s:
+
+    [ Mxx_s  Mxy_s ] [dx ]   [ rx_s ]
+    [ Myx_s  Myy_s ] [dy_s] = [ ry_s ]
+
+all scenarios' Myy are Cholesky-factored IN ONE BATCH, and the
+first-stage Schur complement
+
+    C = sum_s ( Mxx_s - Mxy_s Myy_s^{-1} Myx_s ),   (K x K)
+
+is a plain sum over the scenario axis — under a sharded mesh XLA lowers
+it to a psum, exactly the role of the reference's MPI reduction inside
+parapint.  One K x K solve yields dx; back-substitution (batched) gives
+every dy_s.
+
+Continuous problems only (raises on integer batches), like the
+reference.  Bounds at +-inf get no barrier; equality rows are relaxed
+to a tight box (barrier eps) which keeps the operator SPD.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import global_toc
+from ..spbase import SPBase
+
+BIG = 1e8
+
+
+class SchurComplement(SPBase):
+    def __init__(self, options, all_scenario_names, **kwargs):
+        super().__init__(options, all_scenario_names, **kwargs)
+        if bool(np.asarray(self.batch.integer_mask).any()):
+            raise RuntimeError(
+                "SchurComplement handles continuous problems only "
+                "(so does the reference, opt/sc.py:18-21)")
+        o = self.options
+        self.max_iter = int(o.get("sc_max_iter", 100))
+        self.tol = float(o.get("sc_tol", 1e-7))
+        self.mu0 = float(o.get("sc_mu0", 10.0))
+        self._solve_jit = jax.jit(self._ipm)
+        self.first_stage_solution = None
+        self.objective = None
+
+    # -- problem massaging -------------------------------------------------
+    def _arrays(self):
+        b = self.batch
+        # finite boxes for barrier terms; huge-but-finite where inf
+        lb = jnp.where(jnp.isfinite(b.lb), b.lb, -BIG)
+        ub = jnp.where(jnp.isfinite(b.ub), b.ub, BIG)
+        rlo = jnp.where(jnp.isfinite(b.row_lo), b.row_lo, -BIG)
+        rhi = jnp.where(jnp.isfinite(b.row_hi), b.row_hi, BIG)
+        # equality rows: open a tiny box so slack barriers exist
+        eq = rhi - rlo < 1e-12
+        rlo = jnp.where(eq, rlo - 1e-7, rlo)
+        rhi = jnp.where(eq, rhi + 1e-7, rhi)
+        p = b.prob[:, None]
+        c = b.c * p                 # probability-weighted objective
+        q = b.qdiag * p
+        return c, q, lb, ub, rlo, rhi
+
+    # -- the IPM (all jitted; shapes static) -------------------------------
+    def _ipm(self, c, q, lb, ub, rlo, rhi):
+        b = self.batch
+        S, N = c.shape
+        K = b.num_nonants
+        na = b.nonant_idx
+        rest = jnp.setdiff1d(jnp.arange(N), na, size=N - K,
+                             assume_unique=False)
+        A = b.A
+        prob_mask = (b.tree.prob > 0)[:, None]   # padding scenarios
+
+        # strictly interior start: z near the "small" corner of its
+        # box, s interior of the row box; the coupling Az = s is an
+        # EQUALITY handled by the Newton system (linear -> restored in
+        # one full step), so s need not start consistent
+        z = jnp.clip(jnp.zeros((S, N), c.dtype), lb + 1e-1, ub - 1e-1)
+        zx = jnp.mean(z[:, na], axis=0)
+        z = z.at[:, na].set(jnp.broadcast_to(zx[None, :], (S, K)))
+        s = jnp.clip(jnp.einsum("smn,sn->sm", A, z),
+                     rlo + 1e-1, rhi - 1e-1)
+
+        def barrier_grad_hess(v, lo, hi, mu):
+            g = -mu / (v - lo) + mu / (hi - v)
+            h = mu / (v - lo) ** 2 + mu / (hi - v) ** 2
+            return g, h
+
+        def body(carry, _):
+            z, s, mu = carry
+            gz, hz = barrier_grad_hess(z, lb, ub, mu)
+            gs, hs = barrier_grad_hess(s, rlo, rhi, mu)
+            # Newton-KKT of  min c.z + q/2 z^2 + B(z) + B(s)
+            #               s.t. Az - s = 0
+            # eliminating (ds, dlambda):
+            #   (Hz + A^T Hs A) dz = -(gz_full + A^T(gs + Hs r_eq))
+            #   ds = A dz + r_eq
+            r_eq = jnp.einsum("smn,sn->sm", A, z) - s
+            grad = (c + q * z + gz
+                    + jnp.einsum("smn,sm->sn", A, gs + hs * r_eq))
+            M = (A * hs[:, :, None]).swapaxes(1, 2) @ A
+            M = M + jnp.eye(N)[None] * 1e-10
+            M = M + jnp.zeros_like(M).at[
+                :, jnp.arange(N), jnp.arange(N)].set(q + hz)
+            # zero out padding scenarios (identity keeps Cholesky happy)
+            M = jnp.where(prob_mask[:, :, None], M, jnp.eye(N)[None])
+            grad = jnp.where(prob_mask, grad, 0.0)
+
+            Mxx = M[:, na][:, :, na]                    # (S, K, K)
+            Mxy = M[:, na][:, :, rest]                  # (S, K, N-K)
+            Myy = M[:, rest][:, :, rest]                # (S, n2, n2)
+            rx = -grad[:, na]
+            ry = -grad[:, rest]
+
+            L = jnp.linalg.cholesky(Myy)
+            def chol_solve(Lb, B):
+                w = jax.scipy.linalg.solve_triangular(
+                    Lb, B, lower=True)
+                return jax.scipy.linalg.solve_triangular(
+                    Lb.swapaxes(-1, -2), w, lower=False)
+            Yinv_yx = jax.vmap(chol_solve)(L, Mxy.swapaxes(1, 2))
+            Yinv_ry = jax.vmap(chol_solve)(L, ry[:, :, None])[..., 0]
+            # Schur pieces; the sums over S are the psum analog.
+            # padding scenarios (prob 0) are excluded — their dummy
+            # unit boxes must not constrain the shared step
+            pmask3 = prob_mask[:, :, None]
+            C = jnp.sum(jnp.where(pmask3, Mxx - Mxy @ Yinv_yx, 0.0),
+                        axis=0)
+            rhs = jnp.sum(jnp.where(
+                prob_mask, rx - jnp.einsum("skn,sn->sk", Mxy, Yinv_ry),
+                0.0), axis=0)
+            dx = jnp.linalg.solve(C + jnp.eye(K) * 1e-12, rhs)
+            dy = Yinv_ry - jnp.einsum(
+                "snk,k->sn", Yinv_yx, dx)
+            dz = jnp.zeros_like(z)
+            dz = dz.at[:, na].set(jnp.broadcast_to(dx[None], (S, K)))
+            dz = dz.at[:, rest].set(dy)
+            dz = jnp.where(prob_mask, dz, 0.0)   # pads stay put
+            ds = jnp.einsum("smn,sn->sm", A, dz) + jnp.where(
+                prob_mask, r_eq, 0.0)
+
+            # fraction-to-boundary step
+            def max_step(v, dv, lo, hi):
+                t_lo = jnp.where(dv < 0, (lo - v) / dv, jnp.inf)
+                t_hi = jnp.where(dv > 0, (hi - v) / dv, jnp.inf)
+                return jnp.minimum(jnp.min(t_lo), jnp.min(t_hi))
+
+            alpha = jnp.minimum(
+                1.0, 0.995 * jnp.minimum(
+                    max_step(z, dz, lb, ub), max_step(s, ds, rlo, rhi)))
+            z = z + alpha * dz
+            s = s + alpha * ds
+            # keep strictly interior: compounding 0.995 steps can
+            # round an iterate ONTO its bound, and 1/(z-lb) -> NaN
+            z = jnp.clip(z, lb + 1e-12 * (1 + jnp.abs(lb)),
+                         ub - 1e-12 * (1 + jnp.abs(ub)))
+            s = jnp.clip(s, rlo + 1e-12 * (1 + jnp.abs(rlo)),
+                         rhi - 1e-12 * (1 + jnp.abs(rhi)))
+            # barrier decrease is fast once the (linear) coupling
+            # Az = s is restored, slow while infeasible — shrinking mu
+            # on an infeasible iterate strands a super-optimal point
+            feas = jnp.max(jnp.abs(jnp.where(prob_mask, r_eq, 0.0)))
+            rate = jnp.where(feas < 1e-4, 0.5, 0.95)
+            mu = jnp.maximum(mu * rate, 1e-10)
+            return (z, s, mu), alpha
+
+        (z, s, mu), _ = jax.lax.scan(
+            body, (z, s, self.mu0), None, length=self.max_iter)
+        obj = jnp.sum(jnp.sum(c * z + 0.5 * q * z * z, axis=1)
+                      + b.obj_const * b.tree.prob)
+        return z, obj
+
+    def solve(self):
+        """Reference API: SchurComplement.solve (opt/sc.py:89)."""
+        c, q, lb, ub, rlo, rhi = self._arrays()
+        z, obj = self._solve_jit(c, q, lb, ub, rlo, rhi)
+        self.objective = float(obj)
+        self.first_stage_solution = np.asarray(
+            z[0, np.asarray(self.batch.nonant_idx)])
+        global_toc(f"SchurComplement IPM: obj = {self.objective:.6g}")
+        return self.objective, self.first_stage_solution

@@ -229,14 +229,18 @@ class PHBase(SPOpt):
 
     # -- one PH iteration, fully fused ------------------------------------
     def _superstep_impl(self, state: PHState, rho, W_on, prox_on,
-                        lb=None, ub=None, eps=None):
+                        lb=None, ub=None, eps=None, prep=None):
         b = self.batch
         lb = b.lb if lb is None else lb
         ub = b.ub if ub is None else ub
+        # prep as a traced ARG (not a closure constant): extensions
+        # that edit constraint data (cross-scenario cuts) re-prepare
+        # and the superstep picks it up without recompiling
+        prep = self.prep if prep is None else prep
         c_eff, q_eff = ph_objective_arrays(
             b, state.W, rho, state.xbar, W_on=W_on, prox_on=prox_on)
         res = self.solver._solve_jit(
-            self.prep, c_eff, q_eff, lb, ub, b.obj_const,
+            prep, c_eff, q_eff, lb, ub, b.obj_const,
             state.x, state.y, None, eps)
         x_na = b.nonants(res.x)
         xbar, xsqbar = compute_xbar(b, x_na)
@@ -252,7 +256,7 @@ class PHBase(SPOpt):
         self._ext("pre_solve_loop")
         self.state = self._superstep(
             self.state, self.rho, self.W_on, self.prox_on,
-            self.lb_eff, self.ub_eff, self.solver_eps)
+            self.lb_eff, self.ub_eff, self.solver_eps, self.prep)
         self._ext("post_solve_loop")
         self.conv = float(self.state.conv)
         return self.conv

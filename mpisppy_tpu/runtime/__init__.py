@@ -1,0 +1,1 @@
+from .native import NativeWindow, available  # noqa: F401

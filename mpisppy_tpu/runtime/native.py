@@ -1,0 +1,130 @@
+"""Build + ctypes bindings for the native runtime (runtime/exchange.cpp).
+
+Compiles the shared library on first use with g++ (toolchain is part
+of the target environment), caching by source mtime.  If no compiler
+is available the import still succeeds and `available()` returns False
+— callers fall back to the pure-Python Window.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "exchange.cpp")
+_LIB = os.path.join(_HERE, "libexchange.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _LIB, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        lib.exch_create.restype = ctypes.c_void_p
+        lib.exch_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int]
+        lib.exch_close.argtypes = [ctypes.c_void_p]
+        lib.exch_write.restype = ctypes.c_int64
+        lib.exch_write.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_double),
+                                   ctypes.c_int64, ctypes.c_int64]
+        lib.exch_read.restype = ctypes.c_int64
+        lib.exch_read.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_double),
+                                  ctypes.c_int64]
+        lib.exch_write_id.restype = ctypes.c_int64
+        lib.exch_write_id.argtypes = [ctypes.c_void_p]
+        lib.exch_kill.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available():
+    return _load() is not None
+
+
+class NativeWindow:
+    """Drop-in for cylinders.spcommunicator.Window backed by the C++
+    seqlock exchange; pass `path` for a cross-process (mmap file)
+    window — the DCN-gateway layout."""
+
+    KILL = -1
+
+    def __init__(self, length: int, path: str | None = None,
+                 reset: bool = False):
+        """reset=True reinitializes an existing mmap file (owners pass
+        it; attaching readers must not)."""
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native exchange library unavailable")
+        self._lib = lib
+        self.length = int(length)
+        p = path.encode() if path is not None else None
+        self._h = lib.exch_create(p, self.length, 1 if reset else 0)
+        if not self._h:
+            raise RuntimeError("exch_create failed")
+
+    @property
+    def write_id(self):
+        return int(self._lib.exch_write_id(self._h))
+
+    def write(self, values, write_id=None):
+        values = np.ascontiguousarray(values, dtype=np.float64)
+        if values.shape != (self.length,):
+            raise ValueError(
+                f"window expects shape ({self.length},), "
+                f"got {values.shape}")
+        wid = -1 if write_id is None else int(write_id)
+        out = self._lib.exch_write(
+            self._h, values.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_double)),
+            self.length, wid)
+        if out == -2:
+            raise RuntimeError("native window length mismatch")
+        return int(out)
+
+    def read(self):
+        out = np.empty(self.length, dtype=np.float64)
+        wid = self._lib.exch_read(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            self.length)
+        if wid == -2:
+            raise RuntimeError("native window length mismatch")
+        return out, int(wid)
+
+    def send_kill(self):
+        self._lib.exch_kill(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.exch_close(self._h)
+            self._h = None
+
+    def __del__(self):                                  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

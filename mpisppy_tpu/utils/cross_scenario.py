@@ -1,0 +1,96 @@
+"""Batch augmentation for cross-scenario cuts (TPU-side counterpart of
+the reference's per-scenario eta variables + cut constraints,
+reference: mpisppy/extensions/cross_scen_extension.py:16-283 and
+opt/lshaped eta machinery).
+
+`add_cross_scenario_capacity(batch, max_cuts, eta_weight)` appends
+
+  * one variable `_eta_cross` (an epigraph of the EXPECTED value
+    function E[f](x)), and
+  * `max_cuts` initially-free constraint rows that the hub-side
+    extension fills with aggregate optimality cuts,
+
+and blends every scenario's objective to (1-w) f_s + w eta.  With
+tight cuts, eta = E[f](x) at consensus, so the blended expected
+objective equals E[f]; in between, each subproblem "sees" the other
+scenarios' costs through eta — the cross-scenario information the
+reference shares via its cut matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ir import ScenarioBatch
+
+BIG = 1e9
+
+
+def add_cross_scenario_capacity(batch: ScenarioBatch, max_cuts=20,
+                                eta_weight=0.1) -> ScenarioBatch:
+    S, N, M = batch.num_scens, batch.num_vars, batch.num_rows
+    w = float(eta_weight)
+
+    def pad_col(v, fill):
+        return np.concatenate(
+            [np.asarray(v), np.full((S, 1), fill, np.asarray(v).dtype)],
+            axis=1)
+
+    A = np.zeros((S, M + max_cuts, N + 1))
+    A[:, :M, :N] = np.asarray(batch.A)
+    row_lo = np.concatenate(
+        [np.asarray(batch.row_lo), np.full((S, max_cuts), -np.inf)],
+        axis=1)
+    row_hi = np.concatenate(
+        [np.asarray(batch.row_hi), np.full((S, max_cuts), np.inf)],
+        axis=1)
+
+    newb = ScenarioBatch(
+        c=pad_col((1.0 - w) * np.asarray(batch.c), w),
+        qdiag=pad_col((1.0 - w) * np.asarray(batch.qdiag), 0.0),
+        A=jnp.asarray(A),
+        row_lo=jnp.asarray(row_lo),
+        row_hi=jnp.asarray(row_hi),
+        lb=pad_col(batch.lb, -BIG),
+        ub=pad_col(batch.ub, BIG),
+        obj_const=(1.0 - w) * np.asarray(batch.obj_const),
+        nonant_idx=batch.nonant_idx,
+        integer_mask=pad_col(batch.integer_mask, False),
+        tree=batch.tree,
+        stage_cost_c=None,
+        var_names=tuple(batch.var_names or ()) + ("_eta_cross",),
+    )
+    return newb
+
+
+def cross_meta(batch: ScenarioBatch):
+    """Derive the cut-buffer layout structurally (survives the pytree
+    rebuild in mesh.shard_batch): the eta column is the last variable
+    (named _eta_cross); the cut buffer is the trailing block of rows
+    that are either still free (all-zero, unbounded) or already-
+    installed cuts (coefficient 1.0 on eta)."""
+    if not batch.var_names or batch.var_names[-1] != "_eta_cross":
+        return None
+    A0 = np.asarray(batch.A[0])
+    lo0 = np.asarray(batch.row_lo[0])
+    hi0 = np.asarray(batch.row_hi[0])
+    M, N = A0.shape
+    eta = N - 1
+    first = M
+    n_cuts = 0
+    for r in range(M - 1, -1, -1):
+        is_free = (not A0[r].any()) and np.isinf(lo0[r]) and \
+            np.isinf(hi0[r])
+        is_cut = A0[r, eta] == 1.0
+        if is_free or is_cut:
+            first = r
+            if is_cut:
+                n_cuts += 1
+        else:
+            break
+    return {"first_cut_row": first, "max_cuts": M - first,
+            "n_cuts": n_cuts, "eta_col": eta}

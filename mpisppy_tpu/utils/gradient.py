@@ -1,0 +1,78 @@
+"""Gradient-based rho utilities (reference: mpisppy/utils/gradient.py
+:44-253 + utils/find_rho.py:45-331 + utils/rho_utils.py).
+
+The reference computes per-variable objective-cost gradients per
+scenario, writes them to CSV, and derives rho as an order statistic of
+|gradient| over scenarios scaled by the nonant spread.  Vectorized
+here: one (S, K) gradient tensor, one quantile call.
+"""
+
+from __future__ import annotations
+
+import csv
+
+import numpy as np
+
+
+def grad_cost(opt, x=None):
+    """Per-scenario objective gradient at the nonant slots: (S, K)
+    g = c + qdiag * x restricted to nonant columns (reference
+    gradient.py:44 grad_cost — Pyomo expression differentiation
+    replaced by the closed form of the array IR)."""
+    b = opt.batch
+    if x is None:
+        x = opt.state.x if getattr(opt, "state", None) is not None \
+            else b.lb
+    na = np.asarray(b.nonant_idx)
+    g = np.asarray(b.c)[:, na] + np.asarray(
+        b.qdiag)[:, na] * np.asarray(x)[:, na]
+    return g
+
+
+def find_rho(opt, order_stat=0.5, rel_bound=1e3, x=None):
+    """(K,) rho from gradient order statistics (reference
+    find_rho.py:45 Find_Rho.compute_rho): per slot, the order_stat
+    quantile over scenarios of |g|, divided by the scenario spread of
+    the nonant values (floored at 1), capped at rel_bound * median."""
+    g = np.abs(grad_cost(opt, x=x))
+    S = opt.n_real_scens
+    g = g[:S]
+    quant = np.quantile(g, order_stat, axis=0)
+    if getattr(opt, "state", None) is not None:
+        x_na = np.asarray(opt.batch.nonants(opt.state.x))[:S]
+        spread = np.maximum(x_na.max(axis=0) - x_na.min(axis=0), 1.0)
+    else:
+        spread = np.ones_like(quant)
+    rho = quant / spread
+    med = np.median(rho[rho > 0]) if (rho > 0).any() else 1.0
+    rho = np.clip(rho, med / rel_bound, med * rel_bound)
+    return np.maximum(rho, 1e-6)
+
+
+def write_grad_cost(path, opt, x=None):
+    """CSV: scenario, varname, gradient (reference gradient.py CSV)."""
+    g = grad_cost(opt, x=x)
+    names = opt.batch.tree.nonant_names or tuple(
+        str(k) for k in range(g.shape[1]))
+    scen = opt.batch.tree.scen_names or tuple(
+        str(s) for s in range(g.shape[0]))
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        for s in range(opt.n_real_scens):
+            for k in range(g.shape[1]):
+                w.writerow([scen[s], names[k], g[s, k]])
+
+
+def read_grad_cost(path, opt):
+    g = np.zeros((opt.batch.num_scens, opt.batch.num_nonants))
+    names = {n: k for k, n in enumerate(
+        opt.batch.tree.nonant_names
+        or tuple(str(k) for k in range(g.shape[1])))}
+    scen = {n: s for s, n in enumerate(
+        opt.batch.tree.scen_names
+        or tuple(str(s) for s in range(g.shape[0])))}
+    with open(path, newline="") as f:
+        for row in csv.reader(f):
+            if len(row) == 3 and row[0] in scen and row[1] in names:
+                g[scen[row[0]], names[row[1]]] = float(row[2])
+    return g

@@ -1,0 +1,35 @@
+"""SchurComplement IPM tests (reference analog: mpisppy/tests/test_sc.py
+— farmer objective via the Schur-complement interior point)."""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.opt.sc import SchurComplement
+
+
+def test_sc_farmer_objective():
+    names = [f"scen{i}" for i in range(3)]
+    sc = SchurComplement({}, names, batch=farmer.build_batch(3))
+    obj, x = sc.solve()
+    # reference test_sc checks the farmer objective (-108390)
+    assert obj == pytest.approx(-108390.0, abs=120.0)
+    assert np.allclose(x, [170.0, 80.0, 250.0], atol=3.0)
+
+
+def test_sc_rejects_integers():
+    names = [f"scen{i}" for i in range(3)]
+    with pytest.raises(RuntimeError, match="continuous"):
+        SchurComplement({}, names,
+                        batch=farmer.build_batch(3, use_integer=True))
+
+
+def test_sc_scales_with_scenarios():
+    names = [f"scen{i}" for i in range(10)]
+    sc = SchurComplement({}, names, batch=farmer.build_batch(10))
+    obj, x = sc.solve()
+    # scipy/HiGHS EF value for the 10-scenario perturbed farmer is
+    # -122146.7; the interior point must land just above it
+    assert obj == pytest.approx(-122146.7, rel=2e-3)
+    assert obj >= -122147.0
+    assert np.all(x >= -1e-6)
