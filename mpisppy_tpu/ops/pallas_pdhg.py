@@ -1,0 +1,127 @@
+"""Pallas TPU kernel: fused PDHG iteration chunk.
+
+The PDHG hot loop (ops/pdhg.py `steps`) does, per iteration, two
+batched matvecs plus elementwise prox updates.  Under plain XLA each
+iteration's intermediates round-trip through HBM; this kernel keeps a
+TILE of scenarios' (A, x, y, bounds) resident in VMEM and runs the
+whole `n_steps` chunk on-chip — matvecs on the MXU via dot_general,
+prox math on the VPU — writing back only the chunk's final iterates
+and running sums (which the restart logic consumes).
+
+Grid: 1-D over scenario tiles; every ref is a (TILE_S, ...) VMEM
+block.  Usable on CPU with interpret=True (that is how the unit tests
+pin it against the jnp reference implementation).
+
+See /opt/skills/guides/pallas_guide.md for the API conventions used.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:                                                  # TPU-only module
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except ImportError:                                   # pragma: no cover
+    _VMEM = None
+
+
+def _chunk_kernel(n_steps, A_ref, cs_ref, qs_ref, lb_ref, ub_ref,
+                  rlo_ref, rhi_ref, x_ref, y_ref, tau_ref, sig_ref,
+                  xo_ref, yo_ref, xs_ref, ys_ref):
+    A = A_ref[:]
+    cs = cs_ref[:]
+    qs = qs_ref[:]
+    lb = lb_ref[:]
+    ub = ub_ref[:]
+    rlo = rlo_ref[:]
+    rhi = rhi_ref[:]
+    tau = tau_ref[:]          # (T, 1)
+    sigma = sig_ref[:]        # (T, 1)
+
+    def body(_, carry):
+        x, y, xs, ys = carry
+        # per-scenario matvecs as VPU multiply-reduce over the VMEM-
+        # resident A tile (Mosaic does not lower batched 3-D
+        # dot_general; a matvec is bandwidth-bound so the VPU is the
+        # right unit anyway)
+        aty = jnp.sum(A * y[:, :, None], axis=1)      # (T, N)
+        grad = cs + qs * x + aty
+        xn = jnp.clip(x - tau * grad, lb, ub)
+        xt = 2.0 * xn - x
+        ax = jnp.sum(A * xt[:, None, :], axis=2)      # (T, M)
+        v = y + sigma * ax
+        zc = jnp.clip(v / sigma, rlo, rhi)
+        yn = v - sigma * zc
+        return xn, yn, xs + xn, ys + yn
+
+    x0 = x_ref[:]
+    y0 = y_ref[:]
+    x, y, xs, ys = lax.fori_loop(
+        0, n_steps, body,
+        (x0, y0, jnp.zeros_like(x0), jnp.zeros_like(y0)))
+    xo_ref[:] = x
+    yo_ref[:] = y
+    xs_ref[:] = xs
+    ys_ref[:] = ys
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_steps", "tile_s", "interpret"))
+def fused_chunk(A, cs, qs, lbs, ubs, rlo, rhi, x, y, tau, sigma,
+                n_steps, tile_s=8, interpret=False):
+    """Run `n_steps` PDHG iterations for the whole batch.
+
+    All arrays are SOLVER-SPACE (already Ruiz-scaled) like the inner
+    loop of PDHGSolver._solve_impl.  tau/sigma: (S,) per-scenario step
+    sizes.  Returns (x, y, x_sum, y_sum) exactly matching the jnp
+    `steps` implementation.
+    """
+    S, M, N = A.shape
+    if S % tile_s:
+        tile_s = 1
+    grid = (S // tile_s,)
+    t2 = tau[:, None]
+    s2 = sigma[:, None]
+
+    def tile_spec(*blk):
+        return pl.BlockSpec(blk, lambda i: (i,) + (0,) * (len(blk) - 1))
+
+    kernel = functools.partial(_chunk_kernel, n_steps)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            tile_spec(tile_s, M, N),    # A
+            tile_spec(tile_s, N),       # cs
+            tile_spec(tile_s, N),       # qs
+            tile_spec(tile_s, N),       # lb
+            tile_spec(tile_s, N),       # ub
+            tile_spec(tile_s, M),       # rlo
+            tile_spec(tile_s, M),       # rhi
+            tile_spec(tile_s, N),       # x
+            tile_spec(tile_s, M),       # y
+            tile_spec(tile_s, 1),       # tau
+            tile_spec(tile_s, 1),       # sigma
+        ],
+        out_specs=[
+            tile_spec(tile_s, N),
+            tile_spec(tile_s, M),
+            tile_spec(tile_s, N),
+            tile_spec(tile_s, M),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((S, N), x.dtype),
+            jax.ShapeDtypeStruct((S, M), y.dtype),
+            jax.ShapeDtypeStruct((S, N), x.dtype),
+            jax.ShapeDtypeStruct((S, M), y.dtype),
+        ],
+        interpret=interpret,
+    )(A, cs, qs, lbs, ubs, rlo, rhi, x, y, t2, s2)
+    return tuple(out)

@@ -275,13 +275,26 @@ class PDHGSolver:
     """
 
     def __init__(self, max_iters=20000, eps=1e-6, check_every=40,
-                 restart_every=4, omega0=1.0):
+                 restart_every=4, omega0=1.0, use_pallas="auto",
+                 pallas_tile=8, pallas_interpret=False):
         # restart_every is in units of `check_every` inner iterations
         self.max_iters = int(max_iters)
         self.eps = float(eps)
         self.check_every = int(check_every)
         self.restart_every = int(restart_every)
         self.omega0 = float(omega0)
+        if use_pallas == "auto":
+            # measured on TPU v5e (farmer-64, crops_mult 4): XLA's
+            # fused while_loop beats the Pallas chunk kernel ~100x at
+            # these batched-small-matvec shapes — Pallas grid programs
+            # serialize over scenario tiles while XLA vectorizes the
+            # whole batch.  The kernel stays available (explicitly
+            # pass use_pallas=True) for very large per-scenario
+            # problems where one scenario fills VMEM.
+            use_pallas = False
+        self.use_pallas = bool(use_pallas)
+        self.pallas_tile = int(pallas_tile)
+        self.pallas_interpret = bool(pallas_interpret)
         self._solve_jit = jax.jit(self._solve_impl)
 
     # -- public ----------------------------------------------------------
@@ -376,6 +389,13 @@ class PDHGSolver:
             """n PDHG iterations; returns final + running sums."""
             sigma = 0.9 * omega / anorm
             tau = 0.9 / (omega * anorm + 0.9 * qmax)
+
+            if self.use_pallas and csum is None:
+                from .pallas_pdhg import fused_chunk
+                return fused_chunk(
+                    A, cs, qs, lbs, ubs, rlo, rhi, x, y,
+                    tau, sigma, n, tile_s=self.pallas_tile,
+                    interpret=self.pallas_interpret)
 
             def body(_, carry):
                 x, y, xs, ys = carry

@@ -308,9 +308,7 @@ class PHBase(SPOpt):
         b = self.batch
         W = self.state.W if W is None else W
         c_eff = b.c.at[:, b.nonant_idx].add(W)
-        res = self.solver.solve(
-            self.prep, c_eff, b.qdiag, b.lb, b.ub,
-            obj_const=b.obj_const, x0=self.state.x, y0=self.state.y)
+        res = self.solve_loop(c=c_eff, warm="lagrangian")
         return float(self.Ebound(res.dual_obj))
 
     # -- spoke support ----------------------------------------------------

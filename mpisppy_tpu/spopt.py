@@ -38,6 +38,9 @@ class SPOpt(SPBase):
             eps=float(o.get("pdhg_eps", 1e-6)),
             check_every=int(o.get("pdhg_check_every", 40)),
             restart_every=int(o.get("pdhg_restart_every", 4)),
+            use_pallas=o.get("pdhg_use_pallas", "auto"),
+            pallas_tile=int(o.get("pdhg_pallas_tile", 8)),
+            pallas_interpret=bool(o.get("pdhg_pallas_interpret", False)),
         )
         if prep is not None:
             # shared PreparedBatch from a sibling cylinder over the SAME
@@ -53,6 +56,7 @@ class SPOpt(SPBase):
         # reference spopt.py:877 set_instance_retry — license logic gone)
         self._x_warm = None
         self._y_warm = None
+        self._named_warm = {}
         self._solve_times = []
         # dynamic solver tolerance (Gapper schedules it) as a jnp
         # scalar — traced, so schedule changes never recompile
@@ -65,10 +69,19 @@ class SPOpt(SPBase):
         c/qdiag/lb/ub override the batch's own arrays (this is how PH,
         Lagrangian and xhat objectives/fixings are expressed).
 
+        warm: True/False for the default warm-start cache, or a string
+        TAG for a named cache — repeated bound evaluations (xhat,
+        Lagrangian) warm-start from their own previous solve instead
+        of going cold (the persistent-solver analog, spopt.py:877).
+
         Returns the ops.pdhg.SolveResult.
         """
         b = self.batch
         t0 = time.time()
+        if isinstance(warm, str):
+            cache = self._named_warm.get(warm, (None, None))
+        else:
+            cache = (self._x_warm, self._y_warm) if warm else (None, None)
         res = self.solver.solve(
             self.prep,
             b.c if c is None else c,
@@ -76,11 +89,13 @@ class SPOpt(SPBase):
             b.lb if lb is None else lb,
             b.ub if ub is None else ub,
             obj_const=b.obj_const,
-            x0=self._x_warm if warm else None,
-            y0=self._y_warm if warm else None,
+            x0=cache[0],
+            y0=cache[1],
             eps=self.solver_eps,
         )
-        if warm:
+        if isinstance(warm, str):
+            self._named_warm[warm] = (res.x, res.y)
+        elif warm:
             self._x_warm = res.x
             self._y_warm = res.y
         if dtiming or self.options.get("display_timing"):
@@ -96,6 +111,7 @@ class SPOpt(SPBase):
     def clear_warmstart(self):
         self._x_warm = None
         self._y_warm = None
+        self._named_warm = {}
 
     # -- expectations (Allreduce analogs) ---------------------------------
     def Eobjective(self, objs):
@@ -129,13 +145,15 @@ class SPOpt(SPBase):
         vm = v[np.asarray(mask)]
         return float(np.mean(vm)), float(np.min(vm)), float(np.max(vm))
 
-    def evaluate_xhat(self, nonant_values, upto_stage=None, tol=None):
+    def evaluate_xhat(self, nonant_values, upto_stage=None, tol=None,
+                      warm="xhat_eval"):
         """Expected objective with nonants fixed to a candidate — the
         implementable inner bound (reference utils/xhat_eval.py:293).
-        Returns (Eobj, feasible)."""
+        Returns (Eobj, feasible).  Successive evaluations warm-start
+        from the named cache (candidates move slowly)."""
         lb, ub = self.fixed_nonant_bounds(nonant_values,
                                           upto_stage=upto_stage)
-        res = self.solve_loop(lb=lb, ub=ub, warm=False)
+        res = self.solve_loop(lb=lb, ub=ub, warm=warm)
         feas = self.feas_prob(res, tol=tol) > 1.0 - 1e-6
         return float(self.Eobjective(res.obj)), feas
 
