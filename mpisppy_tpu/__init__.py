@@ -18,6 +18,7 @@ __version__ = "0.1.0"
 
 _T0 = _time.time()
 _TOC_ENABLED = True
+_TOC_SINKS = []
 
 
 def global_toc(msg, cond=True):
@@ -28,6 +29,14 @@ def global_toc(msg, cond=True):
     """
     if cond and _TOC_ENABLED:
         print(f"[{_time.time() - _T0:10.2f}] {msg}", flush=True)
+        for sink in _TOC_SINKS:
+            sink(msg)
+
+
+def add_toc_sink(fn):
+    """Register an extra consumer of the trace (log.global_toc_logger
+    routes it into the logging tree for headless runs)."""
+    _TOC_SINKS.append(fn)
 
 
 def disable_tictoc_output():

@@ -62,10 +62,12 @@ class Hub(SPCommunicator):
                 elif cst == ConvergerSpokeType.NONANT_GETTER:
                     self.nonant_idx_set.add(i)
             self.spoke_chars[i] = sp.converger_spoke_char
+            prefix = self.options.get("window_path_prefix")
             pair = WindowPair(
                 hub_length=sp.receive_length(),
                 spoke_length=sp.send_length(),
-                backend=self.options.get("window_backend", "python"))
+                backend=self.options.get("window_backend", "python"),
+                path_prefix=None if prefix is None else f"{prefix}{i}")
             sp.pair = pair
             self.pairs.append(pair)
         self._spoke_read_ids = np.zeros(len(self.spokes), np.int64)

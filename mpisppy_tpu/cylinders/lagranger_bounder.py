@@ -52,8 +52,10 @@ class LagrangerOuterBound(OuterBoundNonantSpoke):
         xbar, _ = compute_xbar(b, x_na)
         self.W = update_W(self.W, self.rho, x_na, xbar)
         c_eff = b.c.at[:, b.nonant_idx].add(self.W)
+        self.opt.check_W_bound_supported()
         res = self.opt.solve_loop(c=c_eff, warm=True)
-        self.update_if_improving(float(self.opt.Ebound(res.dual_obj)))
+        # valid_Ebound: see cylinders/lagrangian_bounder.py
+        self.update_if_improving(float(self.opt.valid_Ebound(res)))
         self._iter += 1
         return True
 

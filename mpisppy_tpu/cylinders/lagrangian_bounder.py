@@ -23,10 +23,14 @@ class LagrangianOuterBound(_BoundWSpoke):
     def _solve_pass(self, W):
         """W-only re-solve + dual bound (reference
         lagrangian_bounder.py:44-60 lagrangian())."""
+        self.opt.check_W_bound_supported()
         b = self.opt.batch
         c_eff = b.c.at[:, b.nonant_idx].add(jnp.asarray(W, b.c.dtype))
         res = self.opt.solve_loop(c=c_eff, warm=True)
-        self.update_if_improving(float(self.opt.Ebound(res.dual_obj)))
+        # valid_Ebound: finite-box LPs are valid at any iterate;
+        # otherwise uncertified scenarios mask the bound to -inf rather
+        # than publishing a polluted bound to the hub
+        self.update_if_improving(float(self.opt.valid_Ebound(res)))
 
     def step(self):
         W, is_new = self.fresh_Ws()

@@ -1,0 +1,161 @@
+"""Separate-process cylinder deployment over the native seqlock exchange.
+
+Reference counterpart: `WheelSpinner._make_comms` + `sputils.spin_the_wheel`
+launching hub and spokes as distinct MPI programs on a strata_comm
+(reference mpisppy/spin_the_wheel.py:219-237); the cylinders exchange
+through one-sided RMA windows.
+
+Here each spoke runs as its own OS process (its own Python/JAX runtime)
+and dials into the hub's mmap-file windows (runtime/exchange.cpp — the
+same seqlock protocol the in-process modes use, see
+cylinders/spcommunicator.py).  This is the single-box stand-in for the
+multi-host DCN layout: process boundary + shared-memory gateway instead
+of host boundary + network gateway, with identical wire semantics
+(write_id freshness, kill = write_id -1, torn reads impossible by
+seqlock retry).
+
+Because a live jitted optimizer cannot cross an exec boundary, a spoke
+process reconstructs its problem from a declarative spec:
+
+    spec = {
+      "batch": {"module": "mpisppy_tpu.models.farmer",
+                "builder": "build_batch",
+                "kwargs": {"num_scens": 30}},
+      "opt_class":   "mpisppy_tpu.utils.xhat_eval:Xhat_Eval",
+      "spoke_class": "mpisppy_tpu.cylinders.lagrangian_bounder:"
+                     "LagrangianOuterBound",
+      "opt_options": {...}, "spoke_options": {...},
+      "scenario_names": [...],
+      "windows": {"prefix": "/tmp/run/pair0",
+                  "hub_length": N, "spoke_length": M},
+    }
+
+The hub process creates (and owns/resets) the window files BEFORE
+spawning, so attachers never race the initialization.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+
+def _resolve(path: str):
+    mod, _, name = path.partition(":")
+    return getattr(importlib.import_module(mod), name)
+
+
+class SpokeHandle:
+    """Hub-side stand-in for a spoke that lives in another process.
+
+    Carries only the wiring metadata the hub needs (spoke type, display
+    char, window lengths); `step()` is a no-op because the real work
+    happens across the process boundary.  The incumbent solution of an
+    inner-bound spoke comes back through a side file written at spoke
+    finalize (`<prefix>.sol.npy`) — scalar bounds travel through the
+    window itself.
+    """
+
+    def __init__(self, spoke_class, send_length: int, receive_length: int,
+                 sol_path: str | None = None):
+        self.converger_spoke_types = spoke_class.converger_spoke_types
+        self.converger_spoke_char = spoke_class.converger_spoke_char
+        self.provides_cuts = getattr(spoke_class, "provides_cuts", False)
+        self._send_length = int(send_length)
+        self._receive_length = int(receive_length)
+        self._sol_path = sol_path
+        self.pair = None
+        self.proc = None
+
+    def send_length(self):
+        return self._send_length
+
+    def receive_length(self):
+        return self._receive_length
+
+    def step(self):
+        return False
+
+    @property
+    def best_solution(self):
+        if self._sol_path and os.path.exists(self._sol_path):
+            return np.load(self._sol_path)
+        return None
+
+    def finalize(self):
+        return None
+
+
+def spawn_spoke(spec: dict, workdir: str, tag: str,
+                env_overrides: dict | None = None) -> subprocess.Popen:
+    """Launch `python -m mpisppy_tpu.cylinders.proc <specfile>`.
+
+    The child inherits the parent's environment; by default it is pinned
+    to the CPU backend so spoke processes never contend for the single
+    accelerator (on a real multi-host pod each process owns its chips
+    and this override is dropped)."""
+    specfile = os.path.join(workdir, f"spoke_{tag}.json")
+    with open(specfile, "w") as f:
+        json.dump(spec, f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_overrides or {})
+    # child needs the package importable exactly as the parent sees it
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    log_path = os.path.join(workdir, f"spoke_{tag}.log")
+    with open(log_path, "w") as log:
+        # Popen dups the fd; closing the parent-side handle immediately
+        # avoids leaking one fd per spoke in long-lived hub processes
+        p = subprocess.Popen(
+            [sys.executable, "-m", "mpisppy_tpu.cylinders.proc",
+             specfile],
+            env=env, cwd=workdir, stdout=log, stderr=subprocess.STDOUT)
+    p.log_path = log_path
+    return p
+
+
+def run_spoke_from_spec(specfile: str) -> int:
+    """Worker entry: reconstruct the spoke and serve until killed."""
+    from ..utils.platform import ensure_cpu_backend
+    ensure_cpu_backend()
+
+    with open(specfile) as f:
+        spec = json.load(f)
+
+    from .spcommunicator import WindowPair
+
+    bs = spec["batch"]
+    builder = getattr(importlib.import_module(bs["module"]), bs["builder"])
+    batch = builder(**bs.get("kwargs", {}))
+    pad_to = bs.get("pad_to")
+    if pad_to and pad_to > batch.num_scens:
+        # match the hub's device-padded scenario count so the flattened
+        # W/nonant window vectors reshape identically on both sides
+        from ..ir import pad_scenarios
+        batch = pad_scenarios(batch, pad_to)
+    opt_cls = _resolve(spec["opt_class"])
+    spoke_cls = _resolve(spec["spoke_class"])
+    opt = opt_cls(spec.get("opt_options", {}),
+                  spec["scenario_names"], batch=batch)
+    spoke = spoke_cls(opt, options=spec.get("spoke_options"))
+    w = spec["windows"]
+    spoke.pair = WindowPair(w["hub_length"], w["spoke_length"],
+                            backend="native", path_prefix=w["prefix"],
+                            attach=True)
+    spoke.main()
+    sol = getattr(spoke, "best_solution", None)
+    if sol is not None:
+        np.save(w["prefix"] + ".sol.npy", np.asarray(sol))
+    spoke.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_spoke_from_spec(sys.argv[1]))

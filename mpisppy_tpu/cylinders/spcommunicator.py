@@ -81,17 +81,20 @@ class WindowPair:
     """
 
     def __init__(self, hub_length: int, spoke_length: int,
-                 backend: str = "python", path_prefix: str | None = None):
+                 backend: str = "python", path_prefix: str | None = None,
+                 attach: bool = False):
         if backend == "native":
             from ..runtime import NativeWindow
             pth = (lambda tag: None if path_prefix is None
                    else f"{path_prefix}.{tag}")
             # the pair's creator OWNS the windows: reset any stale file
-            # (leftover kill flag / write_id from a previous run)
+            # (leftover kill flag / write_id from a previous run);
+            # attach=True joins EXISTING files (a spoke process dialing
+            # into the hub's windows) and must not reset them
             self.to_spoke = NativeWindow(hub_length, path=pth("to_spoke"),
-                                         reset=True)
+                                         reset=not attach)
             self.to_hub = NativeWindow(spoke_length, path=pth("to_hub"),
-                                       reset=True)
+                                       reset=not attach)
         else:
             self.to_spoke = Window(hub_length)
             self.to_hub = Window(spoke_length)

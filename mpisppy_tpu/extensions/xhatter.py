@@ -1,0 +1,139 @@
+"""In-hub xhat extension family (reference: mpisppy/extensions/
+xhatbase.py:38-230, xhatclosest.py, xhatxbar.py).
+
+The reference evaluates candidate first-stage solutions INSIDE the hub
+via extensions (in addition to the dedicated xhat spokes): an
+XhatBase-derived extension picks candidates at `miditer` /
+`post_everything`, fixes nonants, solves all scenarios, and — when the
+candidate is feasible — publishes the expected objective as an inner
+(upper) bound and records the incumbent.
+
+TPU-native: candidate evaluation is the reduced second-stage stacked
+solve (spopt.evaluate_candidates — ONE kernel launch for k candidates x
+S scenarios), and the winner's bound is certified through
+spopt.evaluate_xhat.  Publication goes to the hub's
+InnerBoundUpdate when the optimizer runs as a hub cylinder, and to
+`opt.best_inner_bound` always.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import global_toc
+from .extension import Extension
+
+
+class XhatBase(Extension):
+    """Shared candidate-evaluation machinery (reference
+    xhatbase.py:38-230 `_try_one` / solve-loop-restore dance; here the
+    evaluation is side-effect-free so there is nothing to restore)."""
+
+    #: evaluate every `cycle` PH iterations (reference runs per-iter)
+    cycle = 1
+
+    def __init__(self, ph, options=None):
+        super().__init__(ph)
+        self.options = dict(options or {})
+        self.cycle = int(self.options.get("cycle", self.cycle))
+        self.best_inner_bound = np.inf if ph.is_minimizing else -np.inf
+        self.best_nonants = None
+        # mirror onto the optimizer for writers/wheel access
+        ph.best_inner_bound = self.best_inner_bound
+        ph.best_inner_nonants = None
+
+    # -- candidate supply (subclasses) -----------------------------------
+    def candidates(self):
+        """Return a (k, K) array of candidate nonant vectors (root-node
+        candidates; multistage callers use evaluate_xhat directly with
+        per-scenario values)."""
+        raise NotImplementedError
+
+    # -- evaluation ------------------------------------------------------
+    def _try_candidates(self):
+        opt = self.opt
+        if opt.state is None:
+            return
+        cands = np.atleast_2d(np.asarray(self.candidates()))
+        if cands.size == 0:
+            return
+        from ..utils.xhat_eval import calculate_incumbent
+        i, obj = calculate_incumbent(opt, cands)
+        if i is None:
+            return
+        better = (obj < self.best_inner_bound if opt.is_minimizing
+                  else obj > self.best_inner_bound)
+        if better:
+            self.best_inner_bound = obj
+            self.best_nonants = cands[i]
+            opt.best_inner_bound = obj
+            opt.best_inner_nonants = cands[i]
+            if opt.spcomm is not None and hasattr(opt.spcomm,
+                                                 "InnerBoundUpdate"):
+                opt.spcomm.InnerBoundUpdate(obj, char=self.char)
+
+    char = "E"
+
+    def miditer(self):
+        if int(self.opt.state.it) % self.cycle == 0:
+            self._try_candidates()
+
+    def post_everything(self):
+        self._try_candidates()
+        if self.best_nonants is not None:
+            global_toc(f"{type(self).__name__}: best inner bound "
+                       f"{self.best_inner_bound:.6g}")
+
+
+class XhatClosest(XhatBase):
+    """Evaluate the scenario solution CLOSEST to xbar (reference
+    extensions/xhatclosest.py: `_vb` sorted squared distance to the
+    root average, then `_try_one` on the winner).
+
+    options: {"keep_solution": bool, "cycle": int}.
+    """
+
+    char = "C"
+
+    def candidates(self):
+        opt = self.opt
+        st = opt.state
+        x_na = np.asarray(opt.batch.nonants(st.x))[: opt.n_real_scens]
+        xbar = np.asarray(st.xbar)[0]
+        d = np.sum((x_na - xbar[None, :]) ** 2, axis=1)
+        order = np.argsort(d)
+        k = int(self.options.get("n_candidates", 1))
+        return x_na[order[:k]]
+
+
+class XhatXbar(XhatBase):
+    """Evaluate the consensus average itself (reference
+    extensions/xhatxbar.py; integer slots are rounded the way the
+    reference's xhat_xbar rounds)."""
+
+    char = "X"
+
+    def candidates(self):
+        opt = self.opt
+        xbar = np.asarray(opt.state.xbar)[0].copy()
+        imask = np.asarray(opt.batch.integer_mask)[
+            0, np.asarray(opt.batch.nonant_idx)]
+        if imask.any():
+            xbar[imask] = np.round(xbar[imask])
+        return xbar[None, :]
+
+
+class XhatSpecific(XhatBase):
+    """Evaluate one named scenario's solution (reference
+    extensions analog of cylinders/xhatspecific_bounder.py).
+    options: {"xhat_scenario_name": str}."""
+
+    char = "S"
+
+    def candidates(self):
+        opt = self.opt
+        name = self.options.get("xhat_scenario_name",
+                                opt.all_scenario_names[0])
+        idx = opt.all_scenario_names.index(name)
+        x_na = np.asarray(opt.batch.nonants(opt.state.x))
+        return x_na[idx][None, :]

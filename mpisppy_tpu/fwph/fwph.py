@@ -145,7 +145,8 @@ class FWPH(PHBase):
                     self.prep, c_eff, b.qdiag, self.lb_eff,
                     self.ub_eff, obj_const=b.obj_const,
                     x0=st.x, y0=st.y)
-                db = float(self.Ebound(res.dual_obj))
+                self.check_W_bound_supported()
+                db = float(self.valid_Ebound(res))
                 self._dual_bounds.append(db)
                 if self.dual_bound is None or db > self.dual_bound:
                     self.dual_bound = db

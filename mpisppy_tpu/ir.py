@@ -99,6 +99,11 @@ class ScenarioBatch:
     # FirstStageCost-style reporting (reference cost_expression per node);
     # optional — None when not provided.
     stage_cost_c: Any = None
+    # (S, K) per-(scenario, nonant-slot) probabilities for consensus
+    # averaging — the reference's variable_probability feature
+    # (spbase.py:394 _mpisppy_variable_probability); None = use the
+    # scenario probabilities uniformly across slots.
+    var_prob: Any = None
     var_names: tuple = ()   # static, length N (reporting only)
 
     @property
@@ -138,7 +143,7 @@ _register(
     ScenarioBatch,
     data_fields=(
         "c", "qdiag", "A", "row_lo", "row_hi", "lb", "ub", "obj_const",
-        "nonant_idx", "integer_mask", "tree", "stage_cost_c",
+        "nonant_idx", "integer_mask", "tree", "stage_cost_c", "var_prob",
     ),
     meta_fields=("var_names",),
 )
@@ -220,6 +225,9 @@ def stack_scenarios(scens, scen_names=None):
     if first.stage_cost_c is not None:
         stage_cost_c = jnp.concatenate(
             [s.stage_cost_c for s in scens], axis=1)
+    var_prob = None
+    if first.var_prob is not None:
+        var_prob = cat("var_prob")
     return ScenarioBatch(
         c=cat("c"), qdiag=cat("qdiag"), A=cat("A"),
         row_lo=cat("row_lo"), row_hi=cat("row_hi"),
@@ -228,6 +236,7 @@ def stack_scenarios(scens, scen_names=None):
         integer_mask=cat("integer_mask"),
         tree=tree,
         stage_cost_c=stage_cost_c,
+        var_prob=var_prob,
         var_names=first.var_names,
     )
 
@@ -278,5 +287,7 @@ def pad_scenarios(batch: ScenarioBatch, to: int) -> ScenarioBatch:
         tree=new_tree,
         stage_cost_c=None if batch.stage_cost_c is None else jnp.pad(
             batch.stage_cost_c, ((0, 0), (0, padn), (0, 0))),
+        var_prob=None if batch.var_prob is None
+        else padfield(batch.var_prob, 0.0),
         var_names=batch.var_names,
     )

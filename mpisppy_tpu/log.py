@@ -1,0 +1,60 @@
+"""Logging configuration (reference: mpisppy/log.py:43-67
+`setup_logger`).
+
+The reference exposes one helper that configures a named logger with a
+level, an optional file target, and a console fallback, so each module
+(`mpisppy.cylinders.hub`, ...) can be tuned independently.  Same
+contract here, stdlib-only; plus `global_toc_logger` to mirror the
+timestamped screen trace (mpisppy_tpu.global_toc) into the logging
+tree when a file target is wanted.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s - %(levelname)s - %(name)s: %(message)s"
+
+
+def setup_logger(name: str, out: str | None = None,
+                 level=logging.INFO, fmt: str = _FORMAT,
+                 mode: str = "w") -> logging.Logger:
+    """Configure and return logger `name` (reference log.py:43-67).
+
+    out: file path, or None / "-" / "stdout" / "stderr" for console.
+    Calling again with the same name replaces the handlers (idempotent
+    reconfiguration, matching the reference's behavior of one handler
+    per named logger).
+    """
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    logger.propagate = False
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+        try:
+            h.close()
+        except Exception:
+            pass
+    if out in (None, "-", "stdout"):
+        handler = logging.StreamHandler(sys.stdout)
+    elif out == "stderr":
+        handler = logging.StreamHandler(sys.stderr)
+    else:
+        handler = logging.FileHandler(out, mode=mode)
+    handler.setLevel(level)
+    handler.setFormatter(logging.Formatter(fmt))
+    logger.addHandler(handler)
+    return logger
+
+
+def global_toc_logger(out: str | None = None, level=logging.INFO):
+    """Route the package's global_toc screen trace into a logger as
+    well (the reference prints via tt_timer only; file capture of the
+    trace is this build's addition for headless TPU runs)."""
+    from mpisppy_tpu import add_toc_sink
+
+    logger = setup_logger("mpisppy_tpu.toc", out=out, level=level,
+                          fmt="%(message)s")
+    add_toc_sink(lambda msg: logger.log(level, msg))
+    return logger

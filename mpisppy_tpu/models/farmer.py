@@ -105,8 +105,25 @@ def build_batch(num_scens, crops_multiplier=1, use_integer=False,
 
     lb = np.zeros((S, N), dtype=dtype)
     ub = np.full((S, N), INF, dtype=dtype)
-    ub[:, iac] = 500.0 * crops_multiplier
-    ub[:, isub] = np.tile(_QUOTA, crops_multiplier)
+    total_acreage = 500.0 * crops_multiplier
+    ub[:, iac] = total_acreage
+    # Implied (presolve-style) finite bounds — provably inactive at some
+    # optimum, so objective values are unchanged, and they make EVERY
+    # variable box finite, which turns the PDHG dual objective into an
+    # exact Lagrangian value for any dual iterate (spopt.Ebound validity
+    # without certification):
+    #  * sales: the limit-sold row gives sub+sup <= yield*x <= yield*total
+    #  * purchases: sub+sup <= yield*x implies the feed row stays
+    #    satisfied when purchases are lowered to the requirement, and
+    #    purchase cost > 0, so an optimal purchase never exceeds req
+    # The 2x margin keeps the boxes STRICTLY inactive (never degenerate
+    # with the rows they were derived from), so dual solutions — and
+    # everything built on them (cross-scenario cuts, reduced-cost
+    # fixing) — are unchanged.
+    sale_cap = 2.0 * yields * total_acreage                # (S, nc)
+    ub[:, isub] = np.minimum(np.tile(_QUOTA, crops_multiplier), sale_cap)
+    ub[:, isup] = sale_cap
+    ub[:, ipur] = 2.0 * np.tile(_CATTLE_REQ + 1.0, crops_multiplier)
 
     c = np.zeros((S, N), dtype=dtype)
     c[:, iac] = np.tile(_PLANTING_COST, crops_multiplier)
@@ -166,10 +183,15 @@ def scenario_creator(scenario_name, use_integer=False, sense=1,
     total = 500.0 * crops_multiplier
     ac = m.add_vars("DevotedAcreage", nc, lb=0.0, ub=total,
                     integer=use_integer)
+    # same implied finite bounds as build_batch (see there for the
+    # optimality argument)
     sub = m.add_vars("QuantitySubQuotaSold", nc, lb=0.0,
-                     ub=np.tile(_QUOTA, crops_multiplier))
-    sup = m.add_vars("QuantitySuperQuotaSold", nc, lb=0.0)
-    pur = m.add_vars("QuantityPurchased", nc, lb=0.0)
+                     ub=np.minimum(np.tile(_QUOTA, crops_multiplier),
+                                   2.0 * y * total))
+    sup = m.add_vars("QuantitySuperQuotaSold", nc, lb=0.0,
+                     ub=2.0 * y * total)
+    pur = m.add_vars("QuantityPurchased", nc, lb=0.0,
+                     ub=2.0 * np.tile(_CATTLE_REQ + 1.0, crops_multiplier))
     req = np.tile(_CATTLE_REQ, crops_multiplier)
     for i in range(nc):
         m.add_constr({ac[i]: y[i], pur[i]: 1.0, sub[i]: -1.0,

@@ -38,9 +38,15 @@ class ExtensiveForm(SPOpt):
             num_nodes=b.tree.num_nodes)
         self._result = None
 
-    def solve_extensive_form(self, solver_options=None, tee=False):
+    def solve_extensive_form(self, solver_options=None, tee=False,
+                             certify=True):
         """One batched consensus solve == the reference's single
-        monolithic solver call (opt/ef.py:66)."""
+        monolithic solver call (opt/ef.py:66).
+
+        certify: if the fast solve leaves the (single, coupled) EF
+        unconverged, re-solve the FULL batch in float64 warm-started —
+        the consensus system cannot be subset the way the per-scenario
+        fallback (spopt._certified_resolve) does."""
         b = self.batch
         p = b.prob[:, None]
         res = self.solver.solve(
@@ -50,6 +56,8 @@ class ExtensiveForm(SPOpt):
             b.lb, b.ub,
             obj_const=b.obj_const * b.prob,
             consensus=self.consensus)
+        if certify and not bool(jnp.all(res.converged)):
+            res = self._certified_ef_resolve(res)
         self._result = res
         global_toc(
             f"EF solve: obj={self.get_objective_value():.6g} "
@@ -57,6 +65,61 @@ class ExtensiveForm(SPOpt):
             f"gap={float(jnp.max(res.gap)):.2e} "
             f"iters={int(res.iters)}", tee)
         return res
+
+    def _certified_ef_resolve(self, res):
+        """Full-batch float64 consensus re-solve, warm-started from the
+        fast result (on the CPU backend when the accelerator lacks
+        f64).  The f32 kernel's primal-residual floor (~1e-4 relative)
+        applies to the EF exactly as to per-scenario solves."""
+        import dataclasses
+
+        import jax
+
+        from .. import global_toc
+        from ..ops.pdhg import PDHGSolver, prepare_batch
+
+        b = self.batch
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            cpu = None
+        with jax.enable_x64():
+            put = ((lambda a: jax.device_put(np.asarray(a, np.float64),
+                                             cpu))
+                   if cpu is not None
+                   else (lambda a: jnp.asarray(np.asarray(a, np.float64))))
+            prep64 = prepare_batch(put(b.A), put(b.row_lo), put(b.row_hi),
+                                   shared_cols=True)
+            s64 = PDHGSolver(max_iters=max(self.solver.max_iters, 100000),
+                             eps=self.solver.eps)
+            p = np.asarray(b.prob, np.float64)[:, None]
+            r64 = s64.solve(
+                prep64,
+                put(np.asarray(b.c, np.float64) * p),
+                put(np.asarray(b.qdiag, np.float64) * p),
+                put(b.lb), put(b.ub),
+                obj_const=put(np.asarray(b.obj_const, np.float64)
+                              * p[:, 0]),
+                x0=put(res.x), y0=put(res.y),
+                consensus=dataclasses.replace(
+                    self.consensus,
+                    node_of=jax.device_put(
+                        np.asarray(self.consensus.node_of, np.int32),
+                        cpu),
+                    nonant_idx=jax.device_put(
+                        np.asarray(self.consensus.nonant_idx, np.int32),
+                        cpu)),
+                eps=float(self.solver.eps))
+            jax.block_until_ready(r64.x)
+        if not bool(jnp.all(r64.converged)):
+            global_toc("WARNING: EF f64 fallback did not fully converge")
+        dt = res.x.dtype
+        cast = lambda a: jnp.asarray(np.asarray(a), dt)  # noqa: E731
+        return dataclasses.replace(
+            res, x=cast(r64.x), y=cast(r64.y), obj=cast(r64.obj),
+            dual_obj=cast(r64.dual_obj), pres=cast(r64.pres),
+            dres=cast(r64.dres), gap=cast(r64.gap),
+            converged=jnp.asarray(np.asarray(r64.converged), bool))
 
     @property
     def solved(self):

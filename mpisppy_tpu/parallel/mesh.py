@@ -63,7 +63,7 @@ class ScenarioMesh:
         # heuristics: nonant_idx is (K,) and K can equal Spad)
         scen_leading = {
             "c", "qdiag", "A", "row_lo", "row_hi", "lb", "ub",
-            "obj_const", "integer_mask", "node_of", "prob",
+            "obj_const", "integer_mask", "node_of", "prob", "var_prob",
         }
 
         def place(path, leaf):

@@ -19,6 +19,7 @@ code for 2-stage (1 node) and multistage.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any
 
 import jax
@@ -28,6 +29,7 @@ import numpy as np
 from . import global_toc
 from .ir import ScenarioBatch, node_segment_sum
 from .spopt import SPOpt
+from .utils import mfu as _mfu
 
 
 def _register(cls, data_fields, meta_fields=()):
@@ -48,6 +50,7 @@ class PHState:
     dual_obj: Any  # (S,)
     conv: Any     # () convergence metric
     it: Any       # () int iteration count
+    solve_iters: Any = 0  # () int kernel iterations of the last solve
 
 
 _register(PHState, tuple(f.name for f in dataclasses.fields(PHState)))
@@ -62,13 +65,22 @@ def compute_xbar(batch: ScenarioBatch, x_na, extra=None):
     packs [xbar||xsqbar] and Allreduces per node comm; here it's a
     segment-sum over node ids, reduced across devices by XLA.
 
+    When the batch carries per-(scenario, slot) probabilities
+    (batch.var_prob — the reference's variable_probability feature,
+    spbase.py:394), those weights replace the scenario probabilities in
+    the average, exactly as the reference's Compute_Xbar consumes
+    `_mpisppy_variable_probability` (phbase.py:71-88).
+
     x_na: (S, K) nonant values.  Returns (xbar, xsqbar), each (S, K),
     gathered back to scenario-slot layout.
     """
     tree = batch.tree
-    p = tree.prob[:, None]                       # (S, 1)
+    if batch.var_prob is not None:
+        p = jnp.asarray(batch.var_prob, x_na.dtype)      # (S, K)
+    else:
+        p = jnp.broadcast_to(tree.prob[:, None], x_na.shape)
     _, segsum = node_segment_sum(tree.node_of, tree.num_nodes)
-    wsum = segsum(jnp.broadcast_to(p, x_na.shape))
+    wsum = segsum(p)
     denom = jnp.maximum(wsum, 1e-30)
     xbar = segsum(p * x_na) / denom
     xsqbar = segsum(p * x_na * x_na) / denom
@@ -160,6 +172,12 @@ class PHBase(SPOpt):
         self.ub_eff = self.batch.ub
         # (solver_eps lives on SPOpt so solve_loop callers — Iter0,
         # spokes, xhat evaluation — honor the Gapper schedule too)
+        # superstep tolerance: PH subproblem solves tolerate loose
+        # accuracy (PH is itself an approximation until the bounds
+        # certify), so the hot loop may run at a looser eps than the
+        # certified bound solves — the analog of the reference's
+        # iterk mipgap vs bound-solve gap split (extensions/mipgapper.py)
+        self._superstep_eps_opt = self.options.get("superstep_eps")
 
         # optional converger (reference phbase.py:726-755 PH_Prep wires
         # options["ph_converger"]; convergers/converger.py API)
@@ -205,22 +223,35 @@ class PHBase(SPOpt):
     def Iter0(self):
         self._ext("pre_iter0")
         global_toc("Iter0: no-penalty solves")
+        # certify="feas": refine (f64) only primal-infeasible scenarios
+        # — matching the reference's infeasibility-only iter0 gate; a
+        # solve legitimately riding to a big artificial box (epigraph
+        # variables pre-cuts) is dual-unconverged but NOT refined
         res = self.solve_loop(lb=self.lb_eff, ub=self.ub_eff, warm=False,
-                              dtiming=self.options.get("display_timing"))
+                              dtiming=self.options.get("display_timing"),
+                              certify="feas")
         feas = self.feas_prob(res)
         if feas < 1.0 - 1e-6:
-            # reference hard-quits on infeasible iter0 (phbase.py:817)
-            global_toc(f"WARNING: iter0 feasible mass only {feas}")
+            # reference hard-quits on infeasible iter0 (phbase.py:817
+            # "quitting after iter 0 because of infeasibility");
+            # set options["iter0_infeasibility_ok"] to downgrade to a
+            # warning (and accept -inf bounds from Ebound's mask)
+            msg = (f"iter0 feasible mass only {feas} after certified "
+                   f"re-solve: infeasible or unsolvable scenario(s)")
+            if self.options.get("iter0_infeasibility_ok", False):
+                global_toc("WARNING: " + msg)
+            else:
+                raise RuntimeError(msg)
         x_na = self.batch.nonants(res.x)
         xbar, xsqbar = compute_xbar(self.batch, x_na)
         W = update_W(jnp.zeros_like(x_na), self.rho, x_na, xbar)
         conv = convergence_metric(self.batch, x_na, xbar)
-        self.trivial_bound = float(self.Ebound(res.dual_obj))
+        self.trivial_bound = float(self.valid_Ebound(res))
         self.best_bound = self.trivial_bound
         self.state = PHState(
             x=res.x, y=res.y, W=W, xbar=xbar, xsqbar=xsqbar,
             obj=res.obj, dual_obj=res.dual_obj, conv=conv,
-            it=jnp.asarray(0, jnp.int32))
+            it=jnp.asarray(0, jnp.int32), solve_iters=res.iters)
         self.conv = float(conv)
         global_toc(f"Iter0 trivial bound = {self.trivial_bound:.6g}, "
                    f"conv = {float(conv):.6g}")
@@ -250,13 +281,33 @@ class PHBase(SPOpt):
         obj = b.objective(res.x)
         return PHState(
             x=res.x, y=res.y, W=W, xbar=xbar, xsqbar=xsqbar,
-            obj=obj, dual_obj=res.dual_obj, conv=conv, it=state.it + 1)
+            obj=obj, dual_obj=res.dual_obj, conv=conv, it=state.it + 1,
+            solve_iters=res.iters)
+
+    @property
+    def superstep_eps(self):
+        """Tolerance of the hot-loop subproblem solves: the
+        superstep_eps option when given, else the DYNAMIC solver_eps
+        (so the Gapper schedule keeps reaching the PH loop)."""
+        if self._superstep_eps_opt is None:
+            return self.solver_eps
+        return jnp.asarray(self._superstep_eps_opt, self.batch.c.dtype)
 
     def ph_iteration(self):
         self._ext("pre_solve_loop")
+        t0 = time.time()
         self.state = self._superstep(
             self.state, self.rho, self.W_on, self.prox_on,
-            self.lb_eff, self.ub_eff, self.solver_eps, self.prep)
+            self.lb_eff, self.ub_eff, self.superstep_eps, self.prep)
+        # account the superstep's kernel work (utils/mfu): iters ride
+        # along in the state so no extra device sync is needed beyond
+        # the conv readback below
+        jax.block_until_ready(self.state.x)
+        b = self.batch
+        self._flops += _mfu.pdhg_flops(
+            int(self.state.solve_iters), b.num_scens, b.num_rows,
+            b.num_vars, self.solver.check_every)
+        self._solve_wall += time.time() - t0
         self._ext("post_solve_loop")
         self.conv = float(self.state.conv)
         return self.conv
@@ -300,16 +351,37 @@ class PHBase(SPOpt):
         return eobj
 
     # -- bounds -----------------------------------------------------------
-    def lagrangian_bound(self, W=None):
+    def lagrangian_bound(self, W=None, certify="auto", eps=None):
         """Valid outer bound from the current W (reference:
         cylinders/lagrangian_bounder.py — re-solve with W-only objective,
         no prox, then Ebound).  Valid because the prob-weighted W sums to
-        zero per node by construction of update_W."""
+        zero per node by construction of update_W.
+
+        certify="auto": when the subproblems are LPs with all-finite
+        variable boxes, the PDHG dual objective equals the Lagrangian
+        g(y) exactly for ANY dual iterate, so the bound is valid without
+        a convergence certificate and the solve never needs the f64
+        fallback (the bound merely tightens as y converges).  Otherwise
+        falls back to certify=True: drive every scenario to the KKT
+        tolerance and mask any uncertified scenario out of the published
+        bound (-inf).  `eps` optionally loosens this solve alone
+        (options key "lagrangian_eps") — a looser y costs bound
+        tightness, never validity (in the auto/LP case)."""
+        self.check_W_bound_supported()
         b = self.batch
         W = self.state.W if W is None else W
         c_eff = b.c.at[:, b.nonant_idx].add(W)
-        res = self.solve_loop(c=c_eff, warm="lagrangian")
-        return float(self.Ebound(res.dual_obj))
+        if certify == "auto":
+            certify = not (self.is_lp and self.all_bounds_finite)
+        if eps is None:
+            eps = self.options.get("lagrangian_eps")
+        if eps is not None:
+            eps = jnp.asarray(eps, b.c.dtype)
+        res = self.solve_loop(c=c_eff, warm="lagrangian", certify=certify,
+                              eps=eps)
+        return float(self.Ebound(res.dual_obj,
+                                 converged=res.converged if certify
+                                 else None))
 
     # -- spoke support ----------------------------------------------------
     def root_xbar(self):

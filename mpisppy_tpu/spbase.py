@@ -36,11 +36,6 @@ class SPBase:
         batch: ScenarioBatch | None = None,
         mesh: ScenarioMesh | None = None,
     ):
-        if variable_probability is not None:
-            raise NotImplementedError(
-                "variable_probability (per-variable probabilities, "
-                "reference spbase.py:394) is not supported yet; "
-                "failing loudly rather than computing wrong xbars")
         self.options = dict(options or {})
         self.all_scenario_names = list(all_scenario_names)
         self.all_nodenames = all_nodenames  # multistage tree metadata
@@ -59,6 +54,22 @@ class SPBase:
             ]
             batch = stack_scenarios(scens, scen_names=self.all_scenario_names)
         self.n_real_scens = len(self.all_scenario_names)
+        if variable_probability is not None:
+            # per-(scenario, nonant-slot) averaging weights (reference
+            # spbase.py:394 _mpisppy_variable_probability): an (S, K)
+            # array, or a callable batch -> (S, K)
+            import dataclasses
+
+            vp = (variable_probability(batch)
+                  if callable(variable_probability)
+                  else variable_probability)
+            vp = jnp.asarray(np.asarray(vp), batch.c.dtype)
+            if vp.shape != (batch.num_scens, batch.num_nonants):
+                raise ValueError(
+                    f"variable_probability must be (S, K) = "
+                    f"({batch.num_scens}, {batch.num_nonants}), "
+                    f"got {vp.shape}")
+            batch = dataclasses.replace(batch, var_prob=vp)
         self.batch = self.mesh.shard_batch(batch)
         self._verify_probabilities()
         # sense: IR is always minimize (model.py negates for maximize);
@@ -78,6 +89,20 @@ class SPBase:
             raise RuntimeError(
                 f"scenario probabilities sum to {tot}, not 1 "
                 "(reference hard-quits here too, spbase.py:470)")
+        if self.batch.var_prob is not None:
+            # reference warns when per-variable probabilities don't sum
+            # to 1 within a node (_check_variable_probabilities_sum,
+            # spbase.py:457-502)
+            from .ir import node_segment_sum
+            tree = self.batch.tree
+            _, segsum = node_segment_sum(tree.node_of, tree.num_nodes)
+            sums = segsum(self.batch.var_prob)
+            bad = jnp.max(jnp.abs(sums - 1.0))
+            if float(bad) > 1e-6:
+                global_toc(
+                    f"WARNING: variable_probability sums deviate from 1 "
+                    f"by up to {float(bad):.3g} within a node "
+                    "(reference warns here too, spbase.py:483)")
 
     # -- gathering / reporting (reference spbase.py:547-651) --------------
     def gather_var_values_to_rank0(self, x=None):

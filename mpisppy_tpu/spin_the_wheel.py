@@ -20,6 +20,7 @@ Dict schema mirrors the reference / vanilla factories:
 
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
@@ -28,13 +29,16 @@ from . import global_toc
 
 
 class WheelSpinner:
-    def __init__(self, hub_dict, list_of_spoke_dict=(), mode="interleaved"):
+    def __init__(self, hub_dict, list_of_spoke_dict=(), mode="interleaved",
+                 keep_workdir=False):
         self._validate(hub_dict, list_of_spoke_dict)
         self.hub_dict = hub_dict
         self.list_of_spoke_dict = list(list_of_spoke_dict)
         self.mode = mode
         self.spcomm = None
         self._ran = False
+        # multiproc mode: keep the window/log tempdir for debugging
+        self.options_keep_workdir = keep_workdir
 
     @staticmethod
     def _validate(hub_dict, spoke_dicts):
@@ -49,6 +53,8 @@ class WheelSpinner:
 
     # -- lifecycle (reference spin_the_wheel.py:119-144) ------------------
     def spin(self):
+        if self.mode == "multiproc":
+            return self._spin_multiproc()
         hd = self.hub_dict
         global_toc("WheelSpinner: constructing hub optimizer")
         hub_opt = hd["opt_class"](**hd["opt_kwargs"])
@@ -104,6 +110,138 @@ class WheelSpinner:
             except Exception as e:  # a failing final pass must not eat
                 global_toc(f"spoke finalize failed: {e}")  # the results
         hub.hub_finalize()
+        self._ran = True
+        return self
+
+    def _spin_multiproc(self):
+        """Hub + spokes as SEPARATE OS processes over the native mmap
+        seqlock exchange (reference spin_the_wheel.py:219-237 runs the
+        cylinders as distinct MPI programs; here the strata boundary is
+        a process boundary and the RMA window is runtime/exchange.cpp).
+
+        Spoke dicts must carry a "proc" key:
+            {"batch": {"module": ..., "builder": ..., "kwargs": {...}}}
+        so the child process can reconstruct the scenario batch itself
+        (a live jitted optimizer cannot cross an exec boundary).
+        """
+        import tempfile
+
+        from .cylinders.proc import SpokeHandle, spawn_spoke
+
+        hd = self.hub_dict
+        workdir = tempfile.mkdtemp(prefix="mpisppy_tpu_wheel_")
+        global_toc(f"WheelSpinner[multiproc]: workdir {workdir} "
+                   "(window files + per-spoke logs)")
+        hub_opt = hd["opt_class"](**hd["opt_kwargs"])
+
+        handles, specs = [], []
+        for i, sd in enumerate(self.list_of_spoke_dict):
+            if "proc" not in sd:
+                raise RuntimeError(
+                    "multiproc mode needs spoke_dict['proc'] with a "
+                    "declarative batch spec")
+            scls = sd["spoke_class"]
+            # lengths mirror the spoke-side formulas (cylinders/spoke.py
+            # receive_length/send_length) computed on the hub's batch —
+            # both sides lower the identical model so shapes agree
+            b = hub_opt.batch
+            recv = b.num_scens * b.num_nonants
+            send = (2 * b.num_nonants + 1
+                    if getattr(scls, "provides_cuts", False) else 1)
+            prefix = f"{workdir}/pair{i}"
+            handles.append(SpokeHandle(scls, send, recv,
+                                       sol_path=prefix + ".sol.npy"))
+            ocls = sd["opt_class"]
+            okw = sd["opt_kwargs"]
+            # the child must pad to the hub's (possibly device-padded)
+            # scenario count or the W/nonant window reshape disagrees
+            bspec = dict(sd["proc"]["batch"], pad_to=b.num_scens)
+            specs.append({
+                "batch": bspec,
+                "opt_class": f"{ocls.__module__}:{ocls.__name__}",
+                "spoke_class": f"{scls.__module__}:{scls.__name__}",
+                "opt_options": okw.get("options", {}),
+                "spoke_options": sd.get("spoke_kwargs", {}).get("options"),
+                "scenario_names": list(okw["all_scenario_names"]),
+                "windows": {"prefix": prefix,
+                            "hub_length": recv, "spoke_length": send},
+            })
+
+        hub = hd["hub_class"](
+            hub_opt, handles,
+            options=dict(hd.get("hub_kwargs", {}).get("options") or {},
+                         window_backend="native",
+                         window_path_prefix=f"{workdir}/pair"))
+        hub.setup_hub()       # creates + resets the window files
+        self.spcomm = hub
+
+        procs = [spawn_spoke(spec, workdir, str(i))
+                 for i, spec in enumerate(specs)]
+        for h, p in zip(handles, procs):
+            h.proc = p
+
+        killed_by_us = set()
+
+        def check_children():
+            """Fail fast when a spoke process died (bad spec, import
+            error, window mismatch) instead of spinning the hub with
+            no incoming bounds.  Processes WE killed (slow to notice
+            the kill signal after a successful run) are not failures."""
+            for i, p in enumerate(procs):
+                rc = p.poll()
+                if rc is not None and rc != 0 and i not in killed_by_us:
+                    tail = ""
+                    lp = getattr(p, "log_path", None)
+                    if lp and os.path.exists(lp):
+                        with open(lp) as f:
+                            tail = "".join(f.readlines()[-15:])
+                    raise RuntimeError(
+                        f"spoke process {i} exited rc={rc}; log tail:\n"
+                        f"{tail}")
+
+        hub.drive_spokes_inline = False
+        ok = False
+        try:
+            import time as _time
+            _time.sleep(0.5)        # catch immediate startup crashes
+            check_children()
+            hub.main()
+            check_children()
+            hub.send_terminate()
+            for i, p in enumerate(procs):
+                try:
+                    p.wait(timeout=120)
+                except Exception:
+                    global_toc(f"spoke {i} still busy 120s after the "
+                               "kill signal; terminating it")
+                    killed_by_us.add(i)
+                    p.kill()
+            check_children()
+            ok = True
+        finally:
+            for i, p in enumerate(procs):
+                if p.poll() is None:
+                    killed_by_us.add(i)
+                    p.kill()
+        hub.hub_finalize()
+        # incumbent pairing: a spoke process writes its solution file
+        # only at finalize (after the kill), long after the hub read the
+        # matching bound from the window — re-pair now that children
+        # have exited (the in-process modes pair live, hub.py:154-156)
+        for i in hub.innerbound_idx:
+            data, wid = hub.pairs[i].to_hub.read()
+            sol = handles[i].best_solution
+            if (wid > 0 and sol is not None
+                    and float(data[0]) == hub.BestInnerBound):
+                hub.best_nonant_solution = sol
+        if ok and not self.options_keep_workdir:
+            # mmap windows/logs are debugging artifacts; clean on
+            # success, keep on failure (the raise above skips this)
+            import shutil
+            for pair in hub.pairs:
+                pair.to_spoke.close()
+                pair.to_hub.close()
+            shutil.rmtree(workdir, ignore_errors=True)
         self._ran = True
         return self
 

@@ -14,6 +14,7 @@ weighted sums over the sharded scenario axis; XLA inserts the psum.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 
 import jax
@@ -23,6 +24,7 @@ import numpy as np
 from . import global_toc
 from .ops.pdhg import PDHGSolver, prepare_batch
 from .spbase import SPBase
+from .utils import mfu as _mfu
 
 
 class SPOpt(SPBase):
@@ -58,13 +60,18 @@ class SPOpt(SPBase):
         self._y_warm = None
         self._named_warm = {}
         self._solve_times = []
+        self._flops = 0.0          # accumulated kernel FLOPs (utils/mfu)
+        self._solve_wall = 0.0     # accumulated timed solve seconds
         # dynamic solver tolerance (Gapper schedules it) as a jnp
         # scalar — traced, so schedule changes never recompile
         self.solver_eps = jnp.asarray(self.solver.eps, self.batch.c.dtype)
+        # f64 fallback solver for certified solves (lazily built)
+        self._solver64 = None
+        self._np_cache = {}
 
     # -- hot path ---------------------------------------------------------
     def solve_loop(self, c=None, qdiag=None, lb=None, ub=None,
-                   warm=True, dtiming=False):
+                   warm=True, dtiming=False, certify=False, eps=None):
         """Solve every scenario subproblem (batched).  Any of
         c/qdiag/lb/ub override the batch's own arrays (this is how PH,
         Lagrangian and xhat objectives/fixings are expressed).
@@ -73,6 +80,24 @@ class SPOpt(SPBase):
         TAG for a named cache — repeated bound evaluations (xhat,
         Lagrangian) warm-start from their own previous solve instead
         of going cold (the persistent-solver analog, spopt.py:877).
+
+        certify: drive scenarios to the KKT tolerance via a float64
+        re-solve.  Scenarios the fast (typically f32) batched solve
+        leaves unconverged — the f32 primal-residual floor sits ~1e-4
+        on ill-scaled instances — are gathered into a compact float64
+        sub-batch and re-solved warm-started (on the CPU backend when
+        the accelerator lacks f64).  This is the analog of the
+        reference's solver-status classification + retry
+        (spopt.py:175-194).  Modes:
+          False  — never refine;
+          True   — refine every non-converged prob>0 scenario;
+          "feas" — refine only PRIMAL-infeasible scenarios (pres over
+                   tolerance).  Dual-side non-convergence is left
+                   alone — it only weakens bounds, which Ebound
+                   handles (mask / finite-box validity) — so solves
+                   that legitimately ride to a big artificial box
+                   (e.g. an epigraph variable before its cuts exist)
+                   are not chased to the bottom.
 
         Returns the ops.pdhg.SolveResult.
         """
@@ -91,22 +116,147 @@ class SPOpt(SPBase):
             obj_const=b.obj_const,
             x0=cache[0],
             y0=cache[1],
-            eps=self.solver_eps,
+            eps=self.solver_eps if eps is None else eps,
         )
+        self._flops += _mfu.pdhg_flops(
+            int(res.iters), b.num_scens, b.num_rows, b.num_vars,
+            self.solver.check_every)
+        if certify:
+            select = None
+            if certify == "feas":
+                tol = 10 * float(self.solver_eps)
+                select = np.asarray(res.pres) >= tol
+            res = self._certified_resolve(res, c, qdiag, lb, ub,
+                                          select=select)
         if isinstance(warm, str):
             self._named_warm[warm] = (res.x, res.y)
         elif warm:
             self._x_warm = res.x
             self._y_warm = res.y
+        jax.block_until_ready(res.x)
+        dt = time.time() - t0
+        self._solve_wall += dt
         if dtiming or self.options.get("display_timing"):
-            jax.block_until_ready(res.x)
-            dt = time.time() - t0
             self._solve_times.append(dt)
             global_toc(f"solve_loop: {dt*1e3:8.1f} ms, "
                        f"iters={int(res.iters)}, "
                        f"conv={int(np.sum(np.asarray(res.converged)))}"
                        f"/{b.num_scens}")
         return res
+
+    # -- certified fallback ----------------------------------------------
+    def _np64(self, key, arr):
+        """Cached float64 numpy view of a static batch array."""
+        hit = self._np_cache.get(key)
+        if hit is None:
+            hit = np.asarray(arr, np.float64)
+            self._np_cache[key] = hit
+        return hit
+
+    def _certified_resolve(self, res, c=None, qdiag=None, lb=None,
+                           ub=None, A=None, row_lo=None, row_hi=None,
+                           obj_const=None, prep_key="_prep64",
+                           select=None):
+        """Re-solve unconverged prob>0 scenarios in float64, warm-started
+        from the fast solve, and scatter the refined solutions back.
+
+        Float32 PDHG stalls at a primal-residual floor ~1e-4 on a small
+        fraction of ill-scaled scenarios (measured: 155/1000 on
+        farmer-1000, crops_mult=10); the same instances converge in
+        ~2.5k f64 iterations.  This path refines INDEPENDENT
+        per-scenario solves only (solve_loop never passes a
+        ConsensusSpec); the coupled consensus (EF) solve has its own
+        full-batch f64 fallback in opt/ef.py solve_extensive_form.
+
+        A/row_lo/row_hi/obj_const override the batch constraint data
+        (the reduced xhat path passes its eliminated-column system);
+        prep_key names the cached f64 scaling for the given A —
+        Ruiz/anorm depend only on A, so the full-batch f64 prep is
+        computed once per key and indexed per call.
+        """
+        conv = np.asarray(res.converged)
+        live = np.asarray(self.batch.prob) > 0
+        pick = ~conv if select is None else np.asarray(select)
+        idx = np.flatnonzero(pick & live)
+        if idx.size == 0:
+            return res
+        b = self.batch
+        A = b.A if A is None else A
+        row_lo = b.row_lo if row_lo is None else row_lo
+        row_hi = b.row_hi if row_hi is None else row_hi
+        obj_const = b.obj_const if obj_const is None else obj_const
+        sub = {
+            "obj_const": np.asarray(obj_const, np.float64)[idx],
+            "row_lo": np.asarray(row_lo, np.float64)[idx],
+            "row_hi": np.asarray(row_hi, np.float64)[idx],
+            "c": np.asarray(b.c if c is None else c, np.float64)[idx],
+            "qdiag": np.asarray(
+                b.qdiag if qdiag is None else qdiag, np.float64)[idx],
+            "lb": np.asarray(b.lb if lb is None else lb, np.float64)[idx],
+            "ub": np.asarray(b.ub if ub is None else ub, np.float64)[idx],
+            "x0": np.asarray(res.x, np.float64)[idx],
+            "y0": np.asarray(res.y, np.float64)[idx],
+        }
+        if self._solver64 is None:
+            self._solver64 = PDHGSolver(
+                max_iters=max(self.solver.max_iters, 100000),
+                eps=self.solver.eps,
+                check_every=self.solver.check_every,
+                restart_every=self.solver.restart_every)
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            cpu = None
+        with jax.enable_x64():
+            put = ((lambda a: jax.device_put(a, cpu))
+                   if cpu is not None else jnp.asarray)
+            full = self._np_cache.get(prep_key)
+            if full is None:
+                full = prepare_batch(
+                    put(np.asarray(A, np.float64)),
+                    put(np.asarray(row_lo, np.float64)),
+                    put(np.asarray(row_hi, np.float64)),
+                    shared_cols=self._shared_cols)
+                full = jax.tree.map(np.asarray, full)
+                self._np_cache[prep_key] = full
+            prep64 = jax.tree.map(lambda a: put(a[idx]), full)
+            # row bounds may be call-specific (xhat candidates shift
+            # them); rebuild the scaled fields from the raw bounds
+            dr = np.asarray(full.d_row)[idx]
+            prep64 = dataclasses.replace(
+                prep64,
+                row_lo=put(np.where(np.isfinite(sub["row_lo"]),
+                                    sub["row_lo"] * dr, sub["row_lo"])),
+                row_hi=put(np.where(np.isfinite(sub["row_hi"]),
+                                    sub["row_hi"] * dr, sub["row_hi"])))
+            r64 = self._solver64.solve(
+                prep64, put(sub["c"]), put(sub["qdiag"]),
+                put(sub["lb"]), put(sub["ub"]),
+                obj_const=put(sub["obj_const"]),
+                x0=put(sub["x0"]), y0=put(sub["y0"]),
+                eps=float(self.solver_eps))
+            jax.block_until_ready(r64.x)
+        self._flops += _mfu.pdhg_flops(
+            int(r64.iters), idx.size, b.num_rows, b.num_vars,
+            self.solver.check_every)
+        n_ok = int(np.sum(np.asarray(r64.converged)))
+        if n_ok < idx.size:
+            global_toc(f"WARNING: f64 fallback left {idx.size - n_ok} "
+                       f"scenario(s) unconverged")
+        dt = res.x.dtype
+        ix = jnp.asarray(idx)
+
+        def scat(a, a64, d=dt):
+            return a.at[ix].set(jnp.asarray(np.asarray(a64), d))
+
+        return dataclasses.replace(
+            res,
+            x=scat(res.x, r64.x), y=scat(res.y, r64.y),
+            obj=scat(res.obj, r64.obj),
+            dual_obj=scat(res.dual_obj, r64.dual_obj),
+            pres=scat(res.pres, r64.pres), dres=scat(res.dres, r64.dres),
+            gap=scat(res.gap, r64.gap),
+            converged=scat(res.converged, r64.converged, bool))
 
     def clear_warmstart(self):
         self._x_warm = None
@@ -120,22 +270,101 @@ class SPOpt(SPBase):
         probability 0 so they vanish."""
         return jnp.sum(self.batch.prob * objs)
 
-    def Ebound(self, dual_objs):
+    def Ebound(self, dual_objs, converged=None):
         """Valid expected outer bound from per-scenario dual objectives
-        (reference spopt.py:346 uses solver bounds)."""
-        return jnp.sum(self.batch.prob * dual_objs)
+        (reference spopt.py:346 uses solver bounds).
+
+        converged: optional (S,) bool certification mask.  A prob>0
+        scenario without a certificate contributes -inf (minimization),
+        so an uncertified solve can never publish a finite bound —
+        the conservative analog of the reference's solver-status gate
+        (spopt.py:175-194).  Use solve_loop(certify=True) to obtain
+        the mask."""
+        vals = self.batch.prob * dual_objs
+        if converged is not None:
+            bad = (~converged) & (self.batch.prob > 0)
+            vals = jnp.where(bad, -jnp.inf, vals)
+        return jnp.sum(vals)
+
+    def reset_solve_stats(self):
+        """Zero the FLOP/wall accumulators (benchmarks call this after
+        compile warmup so the reported MFU covers the timed region)."""
+        self._flops = 0.0
+        self._solve_wall = 0.0
+        self._solve_times = []
+
+    def solve_stats(self):
+        """Accumulated kernel FLOPs / wall-clock / MFU across all
+        solve_loop calls (dtiming analog, extended with hardware
+        utilization — see utils/mfu.py)."""
+        dev = jax.devices()[0]
+        u = _mfu.mfu(self._flops, self._solve_wall, dev)
+        return {
+            "flops": self._flops,
+            "solve_wall_s": self._solve_wall,
+            "mfu": u,
+            "device": getattr(dev, "device_kind", dev.platform),
+        }
 
     def feas_prob(self, res, tol=None):
         """Probability mass of scenarios whose solve is feasible/
         converged (reference spopt.py:411 feas_prob; :175-194
         classifies solver status).  First-order analog: primal residual
-        under tolerance."""
-        tol = tol or 10 * self.solver.eps
+        under tolerance.  The tolerance tracks the DYNAMIC solver_eps
+        (Gapper schedules it per iteration), not the construction-time
+        eps — a deliberately loose early solve is not 'infeasible'."""
+        tol = tol or 10 * float(self.solver_eps)
         ok = res.pres < tol
         return float(jnp.sum(jnp.where(ok, self.batch.prob, 0.0)))
 
     def infeas_prob(self, res, tol=None):
         return 1.0 - self.feas_prob(res, tol)
+
+    @property
+    def is_lp(self):
+        """True when every subproblem is an LP (no quadratic term)."""
+        hit = self._np_cache.get("_is_lp")
+        if hit is None:
+            hit = not bool(jnp.any(self.batch.qdiag != 0))
+            self._np_cache["_is_lp"] = hit
+        return hit
+
+    def valid_Ebound(self, res):
+        """Outer bound that is ALWAYS valid: for LPs with all-finite
+        variable boxes the PDHG dual objective equals the Lagrangian
+        g(y) exactly at ANY iterate, so no certificate is needed;
+        otherwise uncertified scenarios are masked to -inf (Ebound)."""
+        if self.is_lp and self.all_bounds_finite:
+            return self.Ebound(res.dual_obj)
+        return self.Ebound(res.dual_obj, converged=res.converged)
+
+    def check_W_bound_supported(self):
+        """W-based Lagrangian bounds are valid because the scenario-
+        probability-weighted W sums to zero per node (phbase.update_W
+        with probability-weighted xbar).  Under variable_probability
+        the xbar weights differ from the scenario probabilities, that
+        telescoping breaks, and a W-relaxation bound would be WRONG —
+        fail loudly (the conservative stance this build takes wherever
+        a bound would silently lose validity)."""
+        if self.batch.var_prob is not None:
+            raise NotImplementedError(
+                "W-based Lagrangian bounds are not valid under "
+                "variable_probability (prob-weighted W no longer "
+                "telescopes to zero per node); use the EF consensus "
+                "solve or Iter0's W-free bound instead")
+
+    @property
+    def all_bounds_finite(self):
+        """True when every variable box is finite — then the PDHG dual
+        objective is an exact Lagrangian value for ANY dual iterate
+        (no infinite-bound reduced-cost mass to drop), so Ebound is
+        valid without a convergence certificate."""
+        hit = self._np_cache.get("_bounds_finite")
+        if hit is None:
+            hit = bool(jnp.all(jnp.isfinite(self.batch.lb))
+                       and jnp.all(jnp.isfinite(self.batch.ub)))
+            self._np_cache["_bounds_finite"] = hit
+        return hit
 
     def avg_min_max(self, vals):
         """Prob>0-masked avg/min/max of a per-scenario quantity
@@ -145,17 +374,228 @@ class SPOpt(SPBase):
         vm = v[np.asarray(mask)]
         return float(np.mean(vm)), float(np.min(vm)), float(np.max(vm))
 
+    # -- xhat evaluation (reduced second-stage solve) ---------------------
+    #
+    # Fixing nonants via lb=ub=v is how the reference does it (Pyomo
+    # var.fix), but it is hostile to a first-order solver: every fixed
+    # coordinate reads as "at bound" (blinding the dual residual), the
+    # step sizes were tuned for the full operator norm, and — decisive —
+    # a candidate averaged from tolerance-accurate scenario solutions
+    # violates pure-first-stage rows by ~S*eps absolute, making the
+    # equality-fixed problem literally infeasible (measured: xbar on
+    # farmer-1000/f32 violates total-acreage by ~0.05; f64 PDHG then
+    # pins pres at 1e-5 forever with gap ~0.7).  Commercial solvers
+    # absorb this with an absolute feasibility tolerance; we do the
+    # equivalent, structurally: ELIMINATE the fixed columns
+    # (row bounds -= A_na @ v, objective const += c_na @ v), solve the
+    # well-scaled reduced problem with its own Ruiz prep, and widen the
+    # reduced row bounds by a relative feastol (option "xhat_feastol",
+    # default 1e-5 — the analog of Gurobi FeasibilityTol).
+
+    def _xhat_cache(self, upto_stage=None):
+        key = ("xhat_red", upto_stage)
+        hit = self._np_cache.get(key)
+        if hit is not None:
+            return hit
+        b = self.batch
+        na = np.asarray(b.nonant_idx)
+        pos = np.arange(na.size)
+        if upto_stage is not None:
+            stage = np.asarray(b.tree.stage_of)
+            pos = np.flatnonzero(stage <= upto_stage)
+            na = na[pos]
+        nai = jnp.asarray(na, jnp.int32)
+        A_na = jnp.take(b.A, nai, axis=2)              # (S, M, Kf)
+        A_red = jnp.asarray(b.A).at[:, :, nai].set(0.0)
+        c_na = jnp.take(b.c, nai, axis=1)
+        q_na = jnp.take(b.qdiag, nai, axis=1)
+        c_red = jnp.asarray(b.c).at[:, nai].set(0.0)
+        q_red = jnp.asarray(b.qdiag).at[:, nai].set(0.0)
+        lb_red = jnp.asarray(b.lb).at[:, nai].set(0.0)
+        ub_red = jnp.asarray(b.ub).at[:, nai].set(0.0)
+        prep = prepare_batch(A_red, b.row_lo, b.row_hi)
+        # FeasibilityTol analog, scaled to the accuracy of the solves
+        # that GENERATE candidates (the loosest of the solver eps and
+        # the PH hot-loop superstep_eps): a candidate averaged from
+        # eps-accurate solutions violates first-stage rows by ~eps
+        # relative, so a few eps of slack absorbs it; a fixed large
+        # default would grant the reduced LP real objective slack
+        # (measured: ftol=1e-5 at f64/eps=1e-7 made inner bounds
+        # ~4e-5 optimistic)
+        gen_eps = max(self.solver.eps,
+                      float(self.options.get("superstep_eps") or 0.0))
+        ftol = float(self.options.get(
+            "xhat_feastol", min(1e-3, 3.0 * gen_eps)))
+
+        def impl(vals, x0, y0, eps):
+            vals2 = jnp.broadcast_to(
+                jnp.atleast_2d(vals), (b.num_scens, na.size)
+            ).astype(b.c.dtype)
+            shift = jnp.einsum("smk,sk->sm", A_na, vals2)
+            # feastol slack at the scale of the data that produced the
+            # candidate: |shift| (≈|A_na||v|), not the shifted bound —
+            # a candidate averaged from eps-accurate solves violates
+            # pure-first-stage rows by ~eps*|A_na@v| absolute, and a
+            # slack below that leaves the reduced row infeasible (dual
+            # ray, gap→1)
+            slack = ftol * (1.0 + jnp.abs(shift))
+            rlo = b.row_lo - shift
+            rhi = b.row_hi - shift
+            rlo = jnp.where(jnp.isfinite(rlo),
+                            rlo - slack - ftol * (1.0 + jnp.abs(rlo)), rlo)
+            rhi = jnp.where(jnp.isfinite(rhi),
+                            rhi + slack + ftol * (1.0 + jnp.abs(rhi)), rhi)
+            prep2 = dataclasses.replace(
+                prep,
+                row_lo=jnp.where(jnp.isfinite(rlo), rlo * prep.d_row, rlo),
+                row_hi=jnp.where(jnp.isfinite(rhi), rhi * prep.d_row, rhi))
+            oc = (b.obj_const + jnp.sum(c_na * vals2, axis=1)
+                  + 0.5 * jnp.sum(q_na * vals2 * vals2, axis=1))
+            return self.solver._solve_impl(
+                prep2, c_red, q_red, lb_red, ub_red, oc, x0, y0,
+                None, eps), (rlo, rhi, oc)
+
+        hit = {"na": na, "pos": pos, "A_na": A_na, "A_red": A_red,
+               "c_red": c_red,
+               "q_red": q_red, "lb_red": lb_red, "ub_red": ub_red,
+               "prep": prep, "jit": jax.jit(impl), "impl": impl,
+               "ftol": ftol}
+        self._np_cache[key] = hit
+        return hit
+
     def evaluate_xhat(self, nonant_values, upto_stage=None, tol=None,
-                      warm="xhat_eval"):
+                      warm="xhat_eval", certify="auto"):
         """Expected objective with nonants fixed to a candidate — the
         implementable inner bound (reference utils/xhat_eval.py:293).
         Returns (Eobj, feasible).  Successive evaluations warm-start
-        from the named cache (candidates move slowly)."""
-        lb, ub = self.fixed_nonant_bounds(nonant_values,
-                                          upto_stage=upto_stage)
-        res = self.solve_loop(lb=lb, ub=ub, warm=warm)
+        from the named cache (candidates move slowly).
+
+        Validity: the objective at any PRES-FEASIBLE point upper-bounds
+        the subproblem optimum regardless of dual convergence, so the
+        inner bound needs only primal feasibility (within the
+        documented xhat_feastol, the FeasibilityTol analog).
+        certify="auto" runs the f64 fallback only when the fast solve
+        fails the feasibility check; certify=True always refines
+        stragglers."""
+        t0 = time.time()
+        cache = self._xhat_cache(upto_stage)
+        b = self.batch
+        # callers pass full-K candidate vectors; slice to the slots the
+        # cache eliminates (upto_stage filters to early-stage slots)
+        vals = jnp.asarray(nonant_values)[..., jnp.asarray(cache["pos"])]
+        x0, y0 = self._named_warm.get(warm, (None, None))
+        if x0 is None:
+            x0 = jnp.zeros_like(b.c)
+            y0 = jnp.zeros_like(b.row_lo)
+        res, (rlo, rhi, oc) = cache["jit"](
+            vals, x0, y0, self.solver_eps)
+        self._flops += _mfu.pdhg_flops(
+            int(res.iters), b.num_scens, b.num_rows, b.num_vars,
+            self.solver.check_every)
+        if certify == "auto":
+            certify = not (self.feas_prob(res, tol=tol) > 1.0 - 1e-6)
+        if certify:
+            res = self._certified_resolve(
+                res, c=cache["c_red"], qdiag=cache["q_red"],
+                lb=cache["lb_red"], ub=cache["ub_red"],
+                A=cache["A_red"], row_lo=rlo, row_hi=rhi,
+                obj_const=oc, prep_key=("_prep64_xhat", upto_stage))
+        self._named_warm[warm] = (res.x, res.y)
         feas = self.feas_prob(res, tol=tol) > 1.0 - 1e-6
-        return float(self.Eobjective(res.obj)), feas
+        eobj = float(self.Eobjective(res.obj))
+        self._solve_wall += time.time() - t0
+        return eobj, feas
+
+    def evaluate_candidates(self, candidates, tol=None,
+                            warm="xhat_candidates"):
+        """Evaluate k candidates in ONE stacked kernel launch:
+        candidates (k, K) -> (Eobjs (k,), feas (k,)).
+
+        The reduced problem is tiled k-fold along the scenario axis —
+        the speculative-parallelism axis of the reference's xhat spokes
+        (SURVEY.md §2.10) made literal batching.
+
+        This is a SCREENING pass (no f64 certification on the stacked
+        system): pres-based feasibility only.  Certify the winning
+        candidate's bound with evaluate_xhat — calculate_incumbent
+        (utils/xhat_eval.py) does exactly that."""
+        cands = np.asarray(candidates)
+        k, K = cands.shape
+        b = self.batch
+        cache = self._xhat_cache(None)
+        tkey = ("xhat_stack", k)
+        stack = self._np_cache.get(tkey)
+        if stack is None:
+            tile = lambda a: jnp.tile(a, (k,) + (1,) * (a.ndim - 1))  # noqa: E731
+            prep = cache["prep"]
+            nai = jnp.asarray(cache["na"], jnp.int32)
+            stack = {
+                "A_na": tile(cache["A_na"]),
+                "c_na": tile(jnp.take(b.c, nai, axis=1)),
+                "q_na": tile(jnp.take(b.qdiag, nai, axis=1)),
+                "c_red": tile(cache["c_red"]), "q_red": tile(cache["q_red"]),
+                "lb_red": tile(cache["lb_red"]), "ub_red": tile(cache["ub_red"]),
+                "row_lo": tile(b.row_lo), "row_hi": tile(b.row_hi),
+                "obj_const": tile(b.obj_const),
+                "prob": tile(b.prob),
+                "prep": dataclasses.replace(
+                    prep, A=tile(prep.A), row_lo=tile(prep.row_lo),
+                    row_hi=tile(prep.row_hi), d_row=tile(prep.d_row),
+                    d_col=tile(prep.d_col), anorm=tile(prep.anorm)),
+            }
+            ftol = cache["ftol"]
+
+            def impl(vals_ks, x0, y0, eps):
+                # vals_ks: (k, K) -> (k*S, K)
+                vals2 = jnp.repeat(vals_ks, b.num_scens, axis=0).astype(
+                    b.c.dtype)
+                shift = jnp.einsum("smk,sk->sm", stack["A_na"], vals2)
+                slack = ftol * (1.0 + jnp.abs(shift))
+                rlo = stack["row_lo"] - shift
+                rhi = stack["row_hi"] - shift
+                rlo = jnp.where(jnp.isfinite(rlo),
+                                rlo - slack - ftol * (1.0 + jnp.abs(rlo)),
+                                rlo)
+                rhi = jnp.where(jnp.isfinite(rhi),
+                                rhi + slack + ftol * (1.0 + jnp.abs(rhi)),
+                                rhi)
+                p = stack["prep"]
+                prep2 = dataclasses.replace(
+                    p,
+                    row_lo=jnp.where(jnp.isfinite(rlo), rlo * p.d_row, rlo),
+                    row_hi=jnp.where(jnp.isfinite(rhi), rhi * p.d_row, rhi))
+                oc = (stack["obj_const"]
+                      + jnp.sum(stack["c_na"] * vals2, axis=1)
+                      + 0.5 * jnp.sum(stack["q_na"] * vals2 * vals2,
+                                      axis=1))
+                res = self.solver._solve_impl(
+                    prep2, stack["c_red"], stack["q_red"],
+                    stack["lb_red"], stack["ub_red"], oc, x0, y0, None, eps)
+                objs = jnp.sum(
+                    (stack["prob"] * res.obj).reshape(k, b.num_scens),
+                    axis=1)
+                return res, objs
+
+            stack["jit"] = jax.jit(impl)
+            self._np_cache[tkey] = stack
+        t0 = time.time()
+        x0, y0 = self._named_warm.get(warm, (None, None))
+        if x0 is None or x0.shape[0] != k * b.num_scens:
+            x0 = jnp.zeros_like(stack["c_red"])
+            y0 = jnp.zeros_like(stack["row_lo"])
+        res, objs = stack["jit"](jnp.asarray(cands), x0, y0,
+                                 self.solver_eps)
+        jax.block_until_ready(res.x)
+        self._flops += _mfu.pdhg_flops(
+            int(res.iters), k * b.num_scens, b.num_rows, b.num_vars,
+            self.solver.check_every)
+        self._solve_wall += time.time() - t0
+        self._named_warm[warm] = (res.x, res.y)
+        tol = tol or 10 * float(self.solver_eps)
+        ok = (np.asarray(res.pres) < tol).reshape(k, b.num_scens)
+        live = np.asarray(b.prob) > 0
+        feas = np.all(ok | ~live[None, :], axis=1)
+        return np.asarray(objs), feas
 
     # -- nonant fixing (reference spopt.py:592-740 _fix_nonants) ----------
     def fixed_nonant_bounds(self, values, upto_stage=None):

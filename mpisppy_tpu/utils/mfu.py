@@ -1,0 +1,71 @@
+"""FLOP accounting / MFU estimation for the batched solver kernel.
+
+The reference measures nothing hardware-level (its solves cross a
+process boundary into Gurobi); this build's stated bar is knowing how
+far the superstep runs from chip peak, so the solve engine
+(spopt.SPOpt.solve_loop) accumulates matvec FLOPs here and bench.py
+reports `mfu` and `iters_per_sec`.
+
+Peak numbers are per-chip dense matmul peaks from public TPU specs
+(jax-ml.github.io/scaling-book hardware table).  MXU f32 runs at half
+the bf16 rate on most generations; the kernel iterates in f32, so the
+f32 peak is the honest denominator.
+"""
+
+from __future__ import annotations
+
+import os
+
+# (bf16_peak, f32_peak) FLOP/s per chip
+_PEAKS = {
+    "v2": (45e12, 22.5e12),
+    "v3": (123e12, 61.5e12),
+    "v4": (275e12, 137.5e12),
+    "v5e": (197e12, 98.5e12),
+    "v5p": (459e12, 229.5e12),
+    "v6e": (918e12, 459e12),
+}
+
+
+def device_peak_flops(device=None, dtype="float32"):
+    """Best-effort peak FLOP/s for `device` (default: jax.devices()[0]).
+    Override with env TPU_PEAK_FLOPS.  Returns None on CPU (MFU
+    denominator undefined there)."""
+    env = os.environ.get("TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    if device.platform == "cpu":
+        return None
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    col = 0 if "bf16" in dtype else 1
+    for key, peaks in _PEAKS.items():
+        if key in kind:
+            return peaks[col]
+    # unknown TPU kind: assume v5e-class
+    return _PEAKS["v5e"][col]
+
+
+def pdhg_flops(iters, S, M, N, check_every=40):
+    """FLOPs of `iters` PDHG iterations over an (S, M, N) batch.
+
+    Per inner iteration: two batched matvecs (A^T y and A x~), 2*S*M*N
+    mult-adds each -> 4*S*M*N FLOP counting mul+add separately is
+    2*(2*S*M*N)*2... we count 1 FLOP per multiply and per add:
+    each matvec = 2*M*N*S FLOP, so 4*S*M*N per iteration, plus the KKT
+    check (2 more matvecs) every `check_every` iterations.
+    """
+    per_iter = 4.0 * S * M * N
+    checks = 4.0 * S * M * N / max(check_every, 1)
+    return float(iters) * (per_iter + checks)
+
+
+def mfu(flops, wall_seconds, device=None, dtype="float32"):
+    """Model FLOP utilization in [0, 1], or None when no peak is known
+    (CPU)."""
+    peak = device_peak_flops(device, dtype)
+    if peak is None or wall_seconds <= 0:
+        return None
+    return flops / wall_seconds / peak

@@ -38,31 +38,29 @@ class Xhat_Eval(SPOpt):
         res = self.solve_loop(lb=lb, ub=ub, warm=False)
         return float(res.obj[scen_index])
 
-    def evaluate_candidates(self, candidates, tol=None):
-        """Evaluate k candidates at once: candidates (k, K).
-
-        Builds a (k*S)-scenario stacked solve by tiling the batch along
-        the scenario axis — one kernel launch evaluates every candidate
-        against every scenario.  Returns (Eobjs (k,), feas (k,)).
-        """
-        cands = np.asarray(candidates)
-        k = cands.shape[0]
-        outs = []
-        feass = []
-        # Round 1: loop candidates (still one batched solve per
-        # candidate); true k*S stacking lands with the cylinder layer.
-        for i in range(k):
-            e, f = self.evaluate(cands[i], tol=tol)
-            outs.append(e)
-            feass.append(f)
-        return np.array(outs), np.array(feass)
+    # evaluate_candidates — k*S stacked single-launch evaluation — is
+    # inherited from SPOpt (spopt.py): the reduced second-stage system
+    # is tiled k-fold along the scenario axis, so one kernel launch
+    # scores every candidate against every scenario.
 
 
 def calculate_incumbent(ev: Xhat_Eval, candidates):
-    """Best feasible candidate (reference xhat_eval.py:402)."""
-    objs, feas = ev.evaluate_candidates(candidates)
-    objs = np.where(feas, objs, np.inf)
-    i = int(np.argmin(objs))
-    if not np.isfinite(objs[i]):
+    """Best feasible candidate (reference xhat_eval.py:402).
+
+    Two passes: the stacked screening solve ranks all candidates in one
+    kernel launch, then the winner's bound is CERTIFIED through
+    evaluate_xhat (f64 fallback for stragglers) so the published
+    incumbent value is trustworthy.  If screening declares every
+    candidate infeasible, the best-objective one still gets the
+    certified re-check — a fast-solve pres failure is not proof of
+    infeasibility."""
+    cands = np.asarray(candidates)
+    objs, feas = ev.evaluate_candidates(cands)
+    ranked = np.where(feas, objs, np.inf)
+    i = int(np.argmin(ranked))
+    if not np.isfinite(ranked[i]):
+        i = int(np.argmin(objs))
+    obj, ok = ev.evaluate_xhat(cands[i], certify="auto")
+    if not ok:
         return None, None
-    return i, float(objs[i])
+    return i, float(obj)

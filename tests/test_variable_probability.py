@@ -1,0 +1,63 @@
+"""variable_probability tests (reference: mpisppy/spbase.py:394
+_mpisppy_variable_probability consumed by Compute_Xbar,
+phbase.py:71-88; reference test analog tests/test_ef_ph.py
+_vb_callback usage)."""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.opt.ph import PH
+from mpisppy_tpu.phbase import compute_xbar
+
+OPTS = {"defaultPHrho": 1.0, "PHIterLimit": 5, "convthresh": 0.0,
+        "pdhg_eps": 1e-7}
+S = 3
+
+
+def test_var_prob_changes_xbar_weighting():
+    names = [f"scen{i}" for i in range(S)]
+    b = farmer.build_batch(S)
+    K = b.num_nonants
+    # all weight on scenario 0 for slot 0; uniform elsewhere
+    vp = np.full((S, K), 1.0 / S)
+    vp[:, 0] = [1.0, 0.0, 0.0]
+    ph = PH(dict(OPTS), names, batch=b, variable_probability=vp)
+    ph.Iter0()
+    x_na = np.asarray(ph.batch.nonants(ph.state.x))[:S]
+    xbar = np.asarray(ph.state.xbar)[0]
+    assert xbar[0] == pytest.approx(x_na[0, 0], rel=1e-9)
+    assert xbar[1] == pytest.approx(x_na[:, 1].mean(), rel=1e-6)
+
+
+def test_var_prob_shape_guard():
+    names = [f"scen{i}" for i in range(S)]
+    b = farmer.build_batch(S)
+    with pytest.raises(ValueError):
+        PH(dict(OPTS), names, batch=b,
+           variable_probability=np.ones((S, 2)))
+
+
+def test_var_prob_sum_warning(capsys):
+    names = [f"scen{i}" for i in range(S)]
+    b = farmer.build_batch(S)
+    vp = np.full((S, b.num_nonants), 0.5)    # sums to 1.5 per node
+    PH(dict(OPTS), names, batch=b, variable_probability=vp)
+    out = capsys.readouterr().out
+    assert "variable_probability sums deviate" in out
+
+
+def test_compute_xbar_uniform_equivalence():
+    """var_prob == broadcast scenario probs must reproduce the default
+    path bit-for-bit (same formula, same weights)."""
+    import dataclasses
+
+    b = farmer.build_batch(S)
+    x_na = np.random.RandomState(0).rand(S, b.num_nonants)
+    xb0, xs0 = compute_xbar(b, x_na)
+    vp = np.broadcast_to(np.asarray(b.prob)[:, None],
+                         (S, b.num_nonants)).copy()
+    b2 = dataclasses.replace(b, var_prob=vp)
+    xb1, xs1 = compute_xbar(b2, x_na)
+    assert np.allclose(np.asarray(xb0), np.asarray(xb1))
+    assert np.allclose(np.asarray(xs0), np.asarray(xs1))

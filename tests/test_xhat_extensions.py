@@ -1,0 +1,54 @@
+"""In-hub xhat extension family tests (reference:
+mpisppy/extensions/xhatclosest.py, xhatxbar.py, xhatbase.py:38-230 —
+candidate evaluation inside the hub at miditer, not via spokes)."""
+
+import numpy as np
+import pytest
+
+from efcheck import ef_linprog
+from mpisppy_tpu.extensions.extension import MultiExtension
+from mpisppy_tpu.extensions.xhatter import (
+    XhatClosest, XhatSpecific, XhatXbar,
+)
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.opt.ph import PH
+
+OPTS = {"defaultPHrho": 1.0, "PHIterLimit": 40, "convthresh": 1e-4,
+        "pdhg_eps": 1e-7}
+
+
+def run_ph(ext_cls, ext_options=None, S=3):
+    names = [f"scen{i}" for i in range(S)]
+    b = farmer.build_batch(S)
+    ph = PH(dict(OPTS), names, batch=b,
+            extensions=MultiExtension,
+            extension_kwargs={"ext_classes": [ext_cls]})
+    # thread per-extension options through the MultiExtension instance
+    if ext_options is not None:
+        ph.extobject.extdict[ext_cls.__name__].options.update(ext_options)
+    ph.ph_main()
+    return ph, b
+
+
+@pytest.mark.parametrize("ext_cls", [XhatClosest, XhatXbar, XhatSpecific])
+def test_inhub_xhat_inner_bound(ext_cls):
+    ph, b = run_ph(ext_cls)
+    ref, _ = ef_linprog(b, n_real=3)          # -108390
+    ib = ph.best_inner_bound
+    assert np.isfinite(ib)
+    # inner bound is an upper bound on the optimum (within feastol) ...
+    assert ib >= ref - 1.0
+    # ... and PH convergence makes it tight
+    assert ib <= ref + 0.02 * abs(ref)
+    assert ph.best_inner_nonants is not None
+    assert ph.best_inner_nonants.shape == (b.num_nonants,)
+
+
+def test_xhat_closest_picks_nearest_scenario():
+    ph, _ = run_ph(XhatClosest)
+    ext = ph.extobject.extdict["XhatClosest"]
+    cands = ext.candidates()
+    x_na = np.asarray(ph.batch.nonants(ph.state.x))[:3]
+    xbar = np.asarray(ph.state.xbar)[0]
+    d = np.sum((x_na - xbar[None, :]) ** 2, axis=1)
+    assert np.allclose(cands[0], x_na[np.argmin(d)])
