@@ -1,0 +1,10 @@
+"""afew — the quick example subset (analog of the reference's
+examples/afew.py:41-50: farmer cylinders, farmer L-shaped, sizes).
+
+    python examples/afew.py
+"""
+
+import run_all
+
+if __name__ == "__main__":
+    run_all.main(["--fast"])

@@ -1,0 +1,19 @@
+"""aircond_cylinders — multistage production/inventory cylinders
+(analog of the reference's examples/aircond/aircond_cylinders.py).
+
+    python examples/aircond_cylinders.py --branching-factors 3,2 \\
+        --lagrangian --xhatshuffle --max-iterations 40
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import aircond
+
+
+def main(args=None):
+    return cylinders_main(aircond, "aircond_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,19 @@
+"""apl1p_cylinders — the APL1P generator-expansion fixture (analog of
+the reference's mpisppy/tests/examples/apl1p.py usage).
+
+    python examples/apl1p_cylinders.py --num-scens 4 --lagrangian \\
+        --xhatshuffle --max-iterations 30
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import apl1p
+
+
+def main(args=None):
+    return cylinders_main(apl1p, "apl1p_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,19 @@
+"""battery_cylinders — battery arbitrage under price/solar uncertainty
+(analog of the reference's examples/battery driver).
+
+    python examples/battery_cylinders.py --num-scens 8 --lagrangian \\
+        --xhatshuffle --max-iterations 30
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import battery
+
+
+def main(args=None):
+    return cylinders_main(battery, "battery_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -6,6 +6,7 @@ cfg -> vanilla -> WheelSpinner pipeline).
         --xhatshuffle --rel-gap 1e-4 --max-iterations 100
 """
 
+from _driver import standard_cfg  # noqa: F401  (sys.path + CPU guard)
 from mpisppy_tpu.models import farmer
 from mpisppy_tpu.spin_the_wheel import WheelSpinner
 from mpisppy_tpu.utils import config, vanilla

@@ -1,0 +1,22 @@
+"""farmer_ef — one-call extensive-form solve (analog of the
+reference's examples/farmer/farmer_ef.py: build the EF, one monolithic
+solve; here one batched consensus solve).
+
+    python examples/farmer_ef.py --num-scens 3 --EF
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import farmer
+
+
+def main(args=None):
+    args = list(args or [])
+    if "--EF" not in args:
+        args.append("--EF")
+    return cylinders_main(farmer, "farmer_ef", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,39 @@
+"""farmer_lshapedhub — L-shaped (Benders) hub with an xhat spoke
+(analog of the reference's examples/farmer/farmer_lshapedhub.py).
+
+    python examples/farmer_lshapedhub.py --num-scens 3 --xhatlshaped \\
+        --max-iterations 50
+"""
+
+import sys
+
+from _driver import standard_cfg
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.spin_the_wheel import WheelSpinner
+from mpisppy_tpu.utils import vanilla
+
+
+def main(args=None):
+    cfg = standard_cfg()
+    farmer.inparser_adder(cfg)
+    cfg.parse_command_line("farmer_lshapedhub", args=args)
+
+    num_scens = cfg.num_scens
+    names = farmer.scenario_names_creator(num_scens)
+    batch = farmer.build_batch(
+        num_scens, crops_multiplier=cfg.get("crops_multiplier", 1))
+
+    hub = vanilla.lshaped_hub(cfg, farmer.scenario_creator, None, names,
+                              batch=batch)
+    spokes = []
+    if cfg.get("xhatlshaped"):
+        spokes.append(vanilla.xhatlshaped_spoke(
+            cfg, farmer.scenario_creator, None, names, batch=batch))
+    ws = WheelSpinner(hub, spokes).spin()
+    print(f"BestInnerBound = {ws.BestInnerBound}")
+    print(f"BestOuterBound = {ws.BestOuterBound}")
+    return ws
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

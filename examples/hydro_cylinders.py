@@ -1,0 +1,20 @@
+"""hydro_cylinders — multistage hydro scheduling cylinders (analog of
+the reference's examples/hydro/hydro_cylinders.py; 3-stage tree via
+--branching-factors).
+
+    python examples/hydro_cylinders.py --branching-factors 3,3 \\
+        --lagrangian --xhatshuffle --max-iterations 40
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import hydro
+
+
+def main(args=None):
+    return cylinders_main(hydro, "hydro_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,26 @@
+"""netdes_cylinders — stochastic network design cylinders (analog of
+the reference's examples/netdes/netdes_cylinders.py, the
+cross-scenario-cuts showcase).
+
+    python examples/netdes_cylinders.py --num-scens 5 --lagrangian \\
+        --xhatshuffle --cross-scenario-cuts --max-iterations 30
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import netdes
+
+
+def _extra(cfg):
+    cfg.add_to_config("cross_scenario_cuts",
+                      "add the cross-scenario cut spoke", bool, False)
+
+
+def main(args=None):
+    return cylinders_main(netdes, "netdes_cylinders", args=args,
+                          extraargs_fct=_extra)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

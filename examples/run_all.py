@@ -1,0 +1,113 @@
+"""run_all — execute every example driver with real command lines and
+collect failures + timings (analog of the reference's
+examples/run_all.py: runs each family under mpiexec, records `badguys`
+and emits a timing CSV as a side effect).
+
+Here every driver is one process (scenario parallelism is inside the
+batched kernel; multi-device runs shard the same code over a mesh), so
+the runner shells out plain `python <driver> <args>` lines.
+
+    python examples/run_all.py            # full corpus (CPU backend)
+    python examples/run_all.py --fast     # afew-style quick subset
+    python examples/run_all.py --tpu      # keep the ambient platform
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# (driver, argstring) — mirrors the reference's do_one lines
+CORPUS = [
+    ("farmer_cylinders.py",
+     "--num-scens 3 --max-iterations 50 --default-rho 1 "
+     "--lagrangian --xhatshuffle --use-norm-rho-updater"),
+    ("farmer_ef.py", "--num-scens 3"),
+    ("farmer_lshapedhub.py",
+     "--num-scens 3 --max-iterations 50 --xhatlshaped"),
+    ("sizes_cylinders.py",
+     "--num-scens 3 --max-iterations 5 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+    ("sizes_ef_mip.py", "--num-scens 3 --solver-eps 1e-6"),
+    ("sslp_cylinders.py",
+     "--num-scens 10 --max-iterations 20 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+    ("hydro_cylinders.py",
+     "--branching-factors 3,3 --max-iterations 40 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+    ("netdes_cylinders.py",
+     "--num-scens 5 --max-iterations 30 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+    ("uc_cylinders.py",
+     "--num-scens 5 --max-iterations 20 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+    ("aircond_cylinders.py",
+     "--branching-factors 3,2 --max-iterations 30 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+    ("battery_cylinders.py",
+     "--num-scens 8 --max-iterations 30 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+    ("apl1p_cylinders.py",
+     "--num-scens 4 --max-iterations 30 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+]
+
+FAST = {"farmer_cylinders.py", "farmer_lshapedhub.py",
+        "sizes_cylinders.py"}    # the reference's afew.py subset
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    fast = "--fast" in argv
+    rows = []
+    badguys = []
+    env = dict(os.environ)
+    # smoke tier runs on CPU regardless of the ambient platform (the
+    # drivers themselves run on whatever jax picks when launched
+    # directly); pass --tpu to keep the ambient JAX_PLATFORMS
+    if "--tpu" not in argv:
+        env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(HERE)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    for prog, argstring in CORPUS:
+        if fast and prog not in FAST:
+            continue
+        cmd = [sys.executable, os.path.join(HERE, prog)] + argstring.split()
+        print(f"** running: {prog} {argstring}", flush=True)
+        t0 = time.time()
+        r = subprocess.run(cmd, cwd=HERE, env=env,
+                           capture_output=True, text=True)
+        dt = time.time() - t0
+        ok = r.returncode == 0
+        rows.append({"program": prog, "args": argstring,
+                     "seconds": round(dt, 2), "ok": ok})
+        if not ok:
+            badguys.append((prog, r.returncode))
+            print(r.stdout[-2000:])
+            print(r.stderr[-2000:])
+        print(f"   -> {'ok' if ok else 'FAILED'} in {dt:.1f}s",
+              flush=True)
+
+    csv_path = os.path.join(HERE, "run_all_timings.csv")
+    with open(csv_path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=["program", "args", "seconds",
+                                          "ok"])
+        w.writeheader()
+        w.writerows(rows)
+    print(f"timings written to {csv_path}")
+
+    if badguys:
+        print("badguys:")
+        for prog, rc in badguys:
+            print(f"  {prog}: rc={rc}")
+        sys.exit(1)
+    print(f"all {len(rows)} examples passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""sizes_cylinders — hub-and-spokes on the SIZES MIP (analog of the
+reference's examples/sizes/sizes_cylinders.py).
+
+    python examples/sizes_cylinders.py --num-scens 3 --lagrangian \\
+        --xhatshuffle --max-iterations 20 --default-rho 1
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import sizes
+
+
+def main(args=None):
+    return cylinders_main(sizes, "sizes_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,19 @@
+"""sslp_cylinders — hub-and-spokes on stochastic server location
+(analog of the reference's examples/sslp/sslp_cylinders.py).
+
+    python examples/sslp_cylinders.py --num-scens 10 --lagrangian \\
+        --xhatshuffle --max-iterations 20
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import sslp
+
+
+def main(args=None):
+    return cylinders_main(sslp, "sslp_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,19 @@
+"""uc_cylinders — stochastic unit commitment cylinders (analog of the
+reference's examples/uc/uc_cylinders.py and paperruns/larger_uc).
+
+    python examples/uc_cylinders.py --num-scens 10 --lagrangian \\
+        --xhatshuffle --max-iterations 30
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import uc
+
+
+def main(args=None):
+    return cylinders_main(uc, "uc_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

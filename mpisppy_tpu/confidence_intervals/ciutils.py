@@ -87,16 +87,21 @@ def _solver_opts(cfg):
 
 def gap_estimators(xhat_one, mname_or_module, solving_type="EF_2stage",
                    scenario_names=None, sample_options=None,
-                   num_scens=None, seed=0, cfg=None, objective_gap=False):
+                   num_scens=None, seed=0, cfg=None, objective_gap=False,
+                   ArRP=1):
     """Estimate the optimality gap of candidate `xhat_one` on a fresh
-    sample: returns {"G": point estimate, "std": sample std of the
-    per-scenario gap terms, "zhats": E[f(xhat)], "zstar": sampled EF
-    value, "seed": next seed}.
+    sample: returns {"G": point estimate, "std" (alias "s"): sample std
+    of the per-scenario gap terms, "zhats": E[f(xhat)], "zstar": sampled
+    EF value, "seed": next seed}.
 
     Two-stage: G_n = (1/n) sum_s [ f_s(xhat) - f_s(x*_n) ] with x*_n
     the sampled-EF optimizer — the downward-biased MMW estimator; std
     is the (n-1)-dof sample std of those terms (reference
     ciutils.py:208-330).
+
+    ArRP > 1 pools G and s from ArRP disjoint sub-estimators of
+    num_scens/ArRP scenarios each: G = mean(G_i),
+    s = ||(s_i)||_2 / sqrt(n/ArRP) (reference ciutils.py:286-313).
     """
     import importlib
     m = (importlib.import_module(mname_or_module)
@@ -105,6 +110,36 @@ def gap_estimators(xhat_one, mname_or_module, solving_type="EF_2stage",
         num_scens = len(scenario_names) if scenario_names else 10
     if solving_type not in ("EF_2stage", "EF-2stage", "EF_mstage"):
         raise ValueError(f"unknown solving_type {solving_type}")
+
+    if ArRP > 1:
+        if solving_type == "EF_mstage":
+            raise NotImplementedError(
+                "pooled (ArRP) estimators are not supported for "
+                "multistage problems (reference ciutils.py:288)")
+        n = num_scens - num_scens % ArRP
+        npool = n // ArRP
+        Gs, ss, zhs, zss, gobjs = [], [], [], [], []
+        sub_seed = seed
+        for _ in range(ArRP):
+            tmp = gap_estimators(
+                xhat_one, m, solving_type=solving_type,
+                num_scens=npool, seed=sub_seed, cfg=cfg, ArRP=1,
+                objective_gap=objective_gap)
+            sub_seed = tmp["seed"]
+            Gs.append(tmp["G"])
+            ss.append(tmp["std"])
+            zhs.append(tmp["zhats"])
+            zss.append(tmp["zstar"])
+            if objective_gap:
+                gobjs.append(tmp["Gobj"])
+        G = float(np.mean(Gs))
+        s = float(np.linalg.norm(ss) / np.sqrt(npool))
+        out = {"G": G, "std": s, "s": s,
+               "zhats": float(np.mean(zhs)),
+               "zstar": float(np.mean(zss)), "seed": sub_seed}
+        if objective_gap:
+            out["Gobj"] = float(np.mean(gobjs))
+        return out
 
     batch = sample_batch(m, num_scens, seed, cfg)
     num_scens = min(num_scens, batch.num_scens)   # multistage trees
@@ -140,7 +175,7 @@ def gap_estimators(xhat_one, mname_or_module, solving_type="EF_2stage",
     G = float(prob @ gaps)
     # classic MMW uses the iid sample std (uniform probabilities)
     std = float(np.std(gaps, ddof=1)) if num_scens > 1 else 0.0
-    out = {"G": G, "std": std, "zhats": zhat, "zstar": zstar,
+    out = {"G": G, "std": std, "s": std, "zhats": zhat, "zstar": zstar,
            "seed": seed + num_scens}
     if objective_gap:
         out["Gobj"] = zhat - zstar

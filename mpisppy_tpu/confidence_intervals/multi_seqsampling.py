@@ -57,12 +57,16 @@ class IndepScens_SeqSampling(SeqSampling):
         return self.module.build_batch(**kw)
 
     def run(self):
-        n = self.n0
+        n = None
         seed = self.seed
         history = []
         xhat = None
-        G = s = float("nan")
+        G = s = None
         for k in range(1, self.max_iters + 1):
+            # the reference forces kf_Gs = kf_xhat = 1 for multistage
+            # (seqsampling.py:233-241): every sample is a fresh tree;
+            # sizes follow the BM/BPL schedules
+            n = self._sample_size(k, G, s, n)
             xhat1 = self._candidate(n, seed)
             seed += n
             # pad the stage-1 candidate to the full nonant layout for
@@ -78,8 +82,9 @@ class IndepScens_SeqSampling(SeqSampling):
                 num_samples=int(self.options.get("num_eval_samples", 3)))
             seed += 7919
             if not vals:
-                global_toc("IndepScens: no feasible evaluation; growing")
-                n = int(np.ceil(n * self.growth))
+                global_toc("IndepScens: no feasible evaluation; "
+                           "resampling at the schedule's next size")
+                G = s = None
                 continue
             zhat = float(np.mean(vals))
             # gap vs the sampled-tree optimum at this iteration
@@ -94,18 +99,16 @@ class IndepScens_SeqSampling(SeqSampling):
             G = max(zhat - zstar, 0.0)
             s = float(np.std(vals, ddof=1)) if len(vals) > 1 else 0.0
             history.append((n, G, s))
-            if self.stopping_criterion == "BM":
-                stop = G <= self.h * s + self.eps
-            else:
-                tq = ciutils.t_quantile(self.confidence,
-                                        max(len(vals) - 1, 1))
-                stop = G + tq * s / np.sqrt(len(vals)) <= self.eps_prime
+            stop = not self._continue(G, s, max(len(vals), 2))
             global_toc(f"IndepScens iter {k}: n={n} G={G:.6g} "
                        f"s={s:.6g} stop={stop}")
             if stop:
-                return {"xhat_one": xhat, "G": G, "std": s,
-                        "num_scens": n, "T": k, "history": history}
-            n = int(np.ceil(n * self.growth))
-        return {"xhat_one": xhat, "G": G, "std": s, "num_scens": n,
-                "T": self.max_iters, "history": history,
+                upper = (self.h * s + self.eps
+                         if self.stopping_criterion == "BM"
+                         else self.bpl_eps)
+                return {"xhat_one": xhat, "G": G, "std": s, "s": s,
+                        "num_scens": n, "T": k,
+                        "CI": [0.0, float(upper)], "history": history}
+        return {"xhat_one": xhat, "G": G, "std": s, "s": s,
+                "num_scens": n, "T": self.max_iters, "history": history,
                 "stopped": False}

@@ -1,35 +1,48 @@
-"""SIZES — 2-stage production-sizes MIP (structure parity with the
-reference's sizes model, mpisppy/tests/examples/sizes/sizes.py, the
-Jorjani-Scott-Woodruff product-sizes problem).
+"""SIZES — 2-stage production-sizes MIP (reference:
+mpisppy/tests/examples/sizes/ReferenceModel.py + SIZES3/SIZES10 data;
+the two-period version of Lokketangen & Woodruff's product-sizes
+problem, Journal of Heuristics 1996).
 
-A manufacturer produces a product in `num_sizes` sizes over two
-periods.  A size-i unit can be cut down to serve demand for any size
-j <= i at a cutting cost.  Producing any amount of size i in a period
-incurs a setup (binary).  First-period demand is known; second-period
-demand is random.
+This module carries the PUBLISHED instance data of the reference's
+SIZES3/SIZES10 `.dat` files (demands, costs, capacity — problem data,
+not code): 10 product sizes, capacity 200000, setup cost 453 per size
+per period, unit production cost 0.748 + 0.0104*(i-1), flat unit
+reduction (cut-down) cost 0.008, first-period demand
+[2500 7500 12500 10000 35000 25000 15000 12500 12500 5000], and
+second-period demand = factor * first-period demand with factors
+  3 scenarios:  0.7, 1.0, 1.3      (SIZES3/Scenario{1,2,3}.dat)
+  10 scenarios: 0.5, 1.5, 0.6, 0.7, 0.8, 0.9, 1.1, 1.2, 1.3, 1.4
+                                   (SIZES10/Scenario{1..10}.dat)
+Golden value: the 3-scenario EF optimum rounds to 220000 at 2
+significant figures (reference mpisppy/tests/test_ef_ph.py:137), with
+NumProducedFirstStage[5] == 1134 at the optimum (test_ef_ph.py:155).
 
-Per scenario, variables (stage-major; F = num_sizes):
-    z1[i]  in {0,1}  setup, period 1            (nonant)
-    x1[i]  >= 0      production, period 1       (nonant)
-    y1[i,j] (i>=j)   cut i->j, period 1         (nonant)
-    z2[i], x2[i], y2[i,j]                       (recourse)
-Constraints:
-    x_t[i] <= M * z_t[i]                        (setup forcing)
-    sum_j y1[i,j] <= x1[i]                      (cut from period-1 prod)
-    sum_j y2[i,j] <= x1[i] - sum_j y1[i,j] + x2[i]   (leftover + new)
-    sum_{i>=j} y1[i,j] >= d1[j]                 (period-1 demand)
-    sum_{i>=j} y2[i,j] >= d2_s[j]               (period-2 demand, random)
-    sum_i x_t[i] <= cap                         (capacity per period)
-Objective: setup + production + cutting-penalty costs, both periods.
+A size-i unit can be cut down to serve demand for any size j <= i at
+the flat reduction cost.  Producing any units of size i in a period
+incurs a setup (binary, big-M forcing).  Per scenario, variables
+(F = num_sizes, P = F(F+1)/2 ordered pairs i >= j):
 
-Data is generated from a fixed seed (documented synthetic instance —
-the reference ships literal data tables; we generate the same SHAPE of
-instance parametrically).  NOTE the model-structure parity is what the
-tests pin down (EF == scipy linprog on the relaxation).
+    z1[i] in {0,1}   setup, period 1        (derived — NOT nonant,
+                     matching the reference's StageDerivedVariables)
+    x1[i] int [0,cap]  production, period 1   (nonant)
+    y1[i,j] int [0,cap] cut i->j, period 1    (nonant)
+    z2[i], x2[i], y2[i,j]                     (recourse)
 
-`rho_setter` mirrors the reference's sizes rho_setter example
-(examples/sizes/sizes_demo.py): rho proportional to the cost
-coefficient of each nonant.
+Constraints (reference ReferenceModel.py:94-140):
+    x_t[i] - cap * z_t[i] <= 0                 (setup forcing)
+    sum_{j<=i} y1[i,j] - x1[i] <= 0            (inventory, period 1)
+    sum_{j<=i} (y1[i,j] + y2[i,j]) - x1[i] - x2[i] <= 0   (period 2)
+    sum_{i>=j} y1[i,j] >= d1[j]                (period-1 demand)
+    sum_{i>=j} y2[i,j] >= d2_s[j]              (period-2 demand, random)
+    sum_i x_t[i] <= cap                        (capacity per period)
+Objective: sum_t [ setup*z_t + unitcost*x_t + 0.008 * y_t[i,j] (i!=j) ].
+
+All variable boxes are finite ([0,1] / [0,cap]), so the PDHG dual
+objective is a valid Lagrangian bound at any iterate (spopt.Ebound).
+
+`rho_setter` mirrors the reference's sizes _rho_setter
+(tests/examples/sizes/sizes.py:37-58): rho = 0.001 * cost coefficient
+of each nonant (unit production cost for x1, reduction cost for y1).
 """
 
 from __future__ import annotations
@@ -40,36 +53,54 @@ from ..ir import ScenarioBatch, TreeInfo
 
 INF = float("inf")
 
-
-def _instance_data(num_sizes, seed=1134):
-    rng = np.random.RandomState(seed)
-    F = num_sizes
-    setup_cost = 200.0 + 50.0 * rng.rand(F) * np.arange(1, F + 1)
-    prod_cost = 2.0 + rng.rand(F)
-    cut_cost = 0.2
-    d1 = np.round(100.0 + 100.0 * rng.rand(F))
-    d2_base = np.round(100.0 + 100.0 * rng.rand(F))
-    cap = float(np.ceil(1.75 * max(d1.sum(), d2_base.sum())))
-    return dict(setup_cost=setup_cost, prod_cost=prod_cost,
-                cut_cost=cut_cost, d1=d1, d2_base=d2_base, cap=cap)
+# ---- published instance data (reference SIZES3/SIZES10 .dat files) -------
+NUM_SIZES = 10
+CAPACITY = 200000.0
+SETUP_COST = 453.0
+UNIT_COST = 0.748 + 0.0104 * np.arange(NUM_SIZES)
+CUT_COST = 0.008
+DEMAND1 = np.array([2500., 7500., 12500., 10000., 35000.,
+                    25000., 15000., 12500., 12500., 5000.])
+_FACTORS3 = np.array([0.7, 1.0, 1.3])
+_FACTORS10 = np.array([0.5, 1.5, 0.6, 0.7, 0.8, 0.9, 1.1, 1.2, 1.3, 1.4])
 
 
-def scenario_demand(scennum, num_scens, num_sizes, seed=1134):
-    """Period-2 demand for scenario scennum: the base vector scaled by
-    an equally-spaced factor in [0.7, 1.3] (3 scenarios reproduce the
-    classic low/mid/high pattern)."""
-    data = _instance_data(num_sizes, seed)
+def demand_factors(num_scens):
+    """Second-period demand factors: exact reference data for 3 and 10
+    scenarios; evenly spaced in [0.5, 1.5] otherwise (scalable
+    extension for stress runs)."""
+    if num_scens == 3:
+        return _FACTORS3
+    if num_scens == 10:
+        return _FACTORS10
     if num_scens == 1:
-        f = 1.0
-    else:
-        f = 0.7 + 0.6 * scennum / (num_scens - 1)
-    return np.round(data["d2_base"] * f)
+        return np.array([1.0])
+    return 0.5 + np.arange(num_scens) / (num_scens - 1)
 
 
-def build_batch(num_scens, num_sizes=3, seed=1134, dtype=np.float64):
+def scenario_demand(scennum, num_scens, num_sizes=NUM_SIZES):
+    """Period-2 demand vector for one scenario (rounded to integers,
+    exactly as the .dat files carry them)."""
+    f = demand_factors(num_scens)[scennum]
+    return np.round(DEMAND1[:num_sizes] * f)
+
+
+def build_batch(num_scens, num_sizes=NUM_SIZES, dtype=np.float64,
+                seed=None, tighten=True) -> ScenarioBatch:
+    """tighten: replace the reference's loose forcing big-M (the
+    Capacity, ReferenceModel.py:106 "simple upper bound for M") by the
+    presolve-tight value
+        M_i = min(cap, total demand servable by size i over the
+                  horizon, at the scenario's worst case)
+    — a standard MIP-equivalent strengthening (production beyond
+    servable demand is pure cost, so no optimum exceeds M_i); the LP
+    relaxation bound tightens and big-M diving (opt/mip.py) gets honest
+    setup amortization.  tighten=False reproduces the reference's
+    relaxation exactly."""
     F = num_sizes
-    data = _instance_data(F, seed)
     S = num_scens
+    d1 = DEMAND1[:F]
+    cap = CAPACITY
     pairs = [(i, j) for i in range(F) for j in range(F) if i >= j]
     P = len(pairs)
 
@@ -78,32 +109,48 @@ def build_batch(num_scens, num_sizes=3, seed=1134, dtype=np.float64):
     iz2, ix2, iy2 = 2 * F + P, 3 * F + P, 4 * F + P
     N = 4 * F + 2 * P
 
-    # rows: forcing (2F), cut-avail p1 (F), cut-avail p2 (F),
+    # rows: forcing (2F), inventory p1 (F), inventory p2 (F),
     # demand p1 (F), demand p2 (F), capacity (2)
     M = 6 * F + 2
     A = np.zeros((S, M, N), dtype=dtype)
     row_lo = np.full((S, M), -INF, dtype=dtype)
     row_hi = np.full((S, M), INF, dtype=dtype)
+    d2all = np.stack([scenario_demand(s, S, F) for s in range(S)])
+    if tighten:
+        # servable demand by size i: sizes j <= i, both periods (x1 may
+        # pre-produce for period 2 through the p2 inventory row).  x1
+        # is SHARED across scenarios, so its M must cover the
+        # worst-case scenario (max over s) or valid pre-production for
+        # a high-demand scenario would be cut off; x2/z2 are
+        # scenario-local so the scenario's own demand bounds them.
+        cum1 = np.cumsum(d1)
+        cum2 = np.cumsum(d2all, axis=1)                    # (S, F)
+        M1 = np.minimum(
+            cap, cum1[None, :] + np.max(cum2, axis=0)[None, :]
+        ) * np.ones((S, 1))                                # (S, F)
+        M2 = np.minimum(cap, cum2)
+    else:
+        M1 = np.full((S, F), cap)
+        M2 = np.full((S, F), cap)
     r = 0
-    capM = data["cap"]
-    for i in range(F):                      # x1 - M z1 <= 0
+    for i in range(F):                      # x1 - M1 z1 <= 0
         A[:, r, ix1 + i] = 1.0
-        A[:, r, iz1 + i] = -capM
+        A[:, r, iz1 + i] = -M1[:, i]
         row_hi[:, r] = 0.0
         r += 1
-    for i in range(F):                      # x2 - M z2 <= 0
+    for i in range(F):                      # x2 - M2 z2 <= 0
         A[:, r, ix2 + i] = 1.0
-        A[:, r, iz2 + i] = -capM
+        A[:, r, iz2 + i] = -M2[:, i]
         row_hi[:, r] = 0.0
         r += 1
-    for i in range(F):                      # sum_j y1[i,.] - x1 <= 0
+    for i in range(F):                      # sum_{j<=i} y1[i,.] - x1 <= 0
         for p, (pi, pj) in enumerate(pairs):
             if pi == i:
                 A[:, r, iy1 + p] = 1.0
         A[:, r, ix1 + i] = -1.0
         row_hi[:, r] = 0.0
         r += 1
-    for i in range(F):    # sum_j y2[i,.] + sum_j y1[i,.] - x1 - x2 <= 0
+    for i in range(F):  # sum_{j<=i} (y1[i,.]+y2[i,.]) - x1 - x2 <= 0
         for p, (pi, pj) in enumerate(pairs):
             if pi == i:
                 A[:, r, iy2 + p] = 1.0
@@ -116,9 +163,9 @@ def build_batch(num_scens, num_sizes=3, seed=1134, dtype=np.float64):
         for p, (pi, pj) in enumerate(pairs):
             if pj == j:
                 A[:, r, iy1 + p] = 1.0
-        row_lo[:, r] = data["d1"][j]
+        row_lo[:, r] = d1[j]
         r += 1
-    d2 = np.stack([scenario_demand(s, S, F, seed) for s in range(S)])
+    d2 = d2all
     for j in range(F):                      # sum_{i>=j} y2[.,j] >= d2_s
         for p, (pi, pj) in enumerate(pairs):
             if pj == j:
@@ -126,43 +173,46 @@ def build_batch(num_scens, num_sizes=3, seed=1134, dtype=np.float64):
         row_lo[:, r] = d2[:, j]
         r += 1
     A[:, r, ix1:ix1 + F] = 1.0              # capacity p1
-    row_hi[:, r] = data["cap"]
+    row_hi[:, r] = cap
     r += 1
     A[:, r, ix2:ix2 + F] = 1.0              # capacity p2
-    row_hi[:, r] = data["cap"]
+    row_hi[:, r] = cap
     r += 1
     assert r == M
 
     lb = np.zeros((S, N), dtype=dtype)
-    ub = np.full((S, N), INF, dtype=dtype)
+    ub = np.full((S, N), cap, dtype=dtype)
     ub[:, iz1:iz1 + F] = 1.0
     ub[:, iz2:iz2 + F] = 1.0
 
     c = np.zeros((S, N), dtype=dtype)
-    c[:, iz1:iz1 + F] = data["setup_cost"]
-    c[:, iz2:iz2 + F] = data["setup_cost"]
-    c[:, ix1:ix1 + F] = data["prod_cost"]
-    c[:, ix2:ix2 + F] = data["prod_cost"]
-    for p, (pi, pj) in enumerate(pairs):    # cutting penalty ~ distance
-        c[:, iy1 + p] = data["cut_cost"] * (pi - pj)
-        c[:, iy2 + p] = data["cut_cost"] * (pi - pj)
+    c[:, iz1:iz1 + F] = SETUP_COST
+    c[:, iz2:iz2 + F] = SETUP_COST
+    c[:, ix1:ix1 + F] = UNIT_COST[:F]
+    c[:, ix2:ix2 + F] = UNIT_COST[:F]
+    for p, (pi, pj) in enumerate(pairs):    # flat reduction cost, i != j
+        if pi != pj:
+            c[:, iy1 + p] = CUT_COST
+            c[:, iy2 + p] = CUT_COST
 
-    integer_mask = np.zeros((S, N), dtype=bool)
-    integer_mask[:, iz1:iz1 + F] = True
-    integer_mask[:, iz2:iz2 + F] = True
+    # every variable is integer in the reference model (z binary; x, y
+    # NonNegativeIntegers, ReferenceModel.py:70-83)
+    integer_mask = np.ones((S, N), dtype=bool)
 
     stage_cost_c = np.zeros((2, S, N), dtype=dtype)
     stage_cost_c[0, :, : 2 * F + P] = c[:, : 2 * F + P]
     stage_cost_c[1, :, 2 * F + P:] = c[:, 2 * F + P:]
 
-    nonant_idx = np.arange(0, 2 * F + P, dtype=np.int32)
+    # nonants = x1 and y1 (the reference's varlist,
+    # tests/examples/sizes/sizes.py:27); z1 is stage-derived
+    nonant_idx = np.arange(F, 2 * F + P, dtype=np.int32)
     var_names = (
-        tuple(f"z1[{i}]" for i in range(F))
-        + tuple(f"x1[{i}]" for i in range(F))
-        + tuple(f"y1[{i},{j}]" for i, j in pairs)
-        + tuple(f"z2[{i}]" for i in range(F))
-        + tuple(f"x2[{i}]" for i in range(F))
-        + tuple(f"y2[{i},{j}]" for i, j in pairs))
+        tuple(f"ProduceSizeFirstStage[{i+1}]" for i in range(F))
+        + tuple(f"NumProducedFirstStage[{i+1}]" for i in range(F))
+        + tuple(f"NumUnitsCutFirstStage[{i+1},{j+1}]" for i, j in pairs)
+        + tuple(f"ProduceSizeSecondStage[{i+1}]" for i in range(F))
+        + tuple(f"NumProducedSecondStage[{i+1}]" for i in range(F))
+        + tuple(f"NumUnitsCutSecondStage[{i+1},{j+1}]" for i, j in pairs))
     tree = TreeInfo(
         node_of=np.zeros((S, len(nonant_idx)), np.int32),
         prob=np.full((S,), 1.0 / S, dtype=dtype),
@@ -179,11 +229,12 @@ def build_batch(num_scens, num_sizes=3, seed=1134, dtype=np.float64):
         tree=tree, stage_cost_c=stage_cost_c, var_names=var_names)
 
 
-def rho_setter(batch, rho_scale_factor=1.0):
-    """Cost-proportional rho (reference: examples/sizes rho_setter):
-    rho_k = scale * |c_k| / 2 at each nonant slot, floored at scale."""
+def rho_setter(batch, rho_scale_factor=0.001):
+    """Cost-proportional rho (reference tests/examples/sizes/sizes.py:37
+    _rho_setter: rho = RF * unit production cost for NumProduced slots,
+    RF * reduction cost for NumUnitsCut slots, RF = 0.001)."""
     c_na = np.abs(np.asarray(batch.c))[:, np.asarray(batch.nonant_idx)]
-    return np.maximum(rho_scale_factor * c_na / 2.0, rho_scale_factor)
+    return rho_scale_factor * np.maximum(c_na, CUT_COST)
 
 
 def scenario_names_creator(num_scens, start=0):
@@ -193,8 +244,21 @@ def scenario_names_creator(num_scens, start=0):
 def inparser_adder(cfg):
     cfg.num_scens_required()
     cfg.add_to_config("num_sizes", description="number of product sizes",
-                      domain=int, default=3)
+                      domain=int, default=NUM_SIZES)
 
 
 def kw_creator(options):
-    return {"num_sizes": options.get("num_sizes", 3)}
+    return {"num_sizes": options.get("num_sizes", NUM_SIZES)}
+
+
+def batch_creator(cfg_or_kwargs, num_scens=None):
+    kw = dict(cfg_or_kwargs)
+    n = num_scens or kw.pop("num_scens", None)
+    kw.pop("num_scens", None)
+    kw.pop("use_integer", None)
+    kw.pop("crops_multiplier", None)
+    return build_batch(n, **kw)
+
+
+def scenario_denouement(rank, scenario_name, result):
+    pass

@@ -66,11 +66,18 @@ class ExtensiveForm(SPOpt):
             f"iters={int(res.iters)}", tee)
         return res
 
-    def _certified_ef_resolve(self, res):
+    def _certified_ef_resolve(self, res, c=None, qdiag=None, lb=None,
+                              ub=None, obj_const=None):
         """Full-batch float64 consensus re-solve, warm-started from the
         fast result (on the CPU backend when the accelerator lacks
         f64).  The f32 kernel's primal-residual floor (~1e-4 relative)
-        applies to the EF exactly as to per-scenario solves."""
+        applies to the EF exactly as to per-scenario solves.
+
+        c/qdiag/lb/ub/obj_const override the batch's own
+        (probability-weighted) arrays — callers solving a MODIFIED EF
+        (opt/mip.py dives fix integer boxes) MUST pass their arrays or
+        the fallback would silently re-solve the unmodified EF and
+        report its solution as the modified one."""
         import dataclasses
 
         import jax
@@ -79,6 +86,15 @@ class ExtensiveForm(SPOpt):
         from ..ops.pdhg import PDHGSolver, prepare_batch
 
         b = self.batch
+        p = np.asarray(b.prob, np.float64)[:, None]
+        if c is None:
+            c = np.asarray(b.c, np.float64) * p
+        if qdiag is None:
+            qdiag = np.asarray(b.qdiag, np.float64) * p
+        if obj_const is None:
+            obj_const = np.asarray(b.obj_const, np.float64) * p[:, 0]
+        lb = b.lb if lb is None else lb
+        ub = b.ub if ub is None else ub
         try:
             cpu = jax.devices("cpu")[0]
         except RuntimeError:
@@ -92,14 +108,12 @@ class ExtensiveForm(SPOpt):
                                    shared_cols=True)
             s64 = PDHGSolver(max_iters=max(self.solver.max_iters, 100000),
                              eps=self.solver.eps)
-            p = np.asarray(b.prob, np.float64)[:, None]
             r64 = s64.solve(
                 prep64,
-                put(np.asarray(b.c, np.float64) * p),
-                put(np.asarray(b.qdiag, np.float64) * p),
-                put(b.lb), put(b.ub),
-                obj_const=put(np.asarray(b.obj_const, np.float64)
-                              * p[:, 0]),
+                put(c),
+                put(qdiag),
+                put(lb), put(ub),
+                obj_const=put(obj_const),
                 x0=put(res.x), y0=put(res.y),
                 consensus=dataclasses.replace(
                     self.consensus,

