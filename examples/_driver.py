@@ -32,7 +32,9 @@ def standard_cfg():
     cfg.lagranger_args()
     cfg.xhatlooper_args()
     cfg.xhatshuffle_args()
+    cfg.xhatspecific_args()
     cfg.xhatxbar_args()
+    cfg.xhatlshaped_args()
     cfg.slammax_args()
     cfg.slammin_args()
     cfg.fixer_args()
@@ -53,7 +55,7 @@ def cylinders_main(module, progname, args=None, extraargs_fct=None):
     if extraargs_fct is not None:
         extraargs_fct(cfg)
     ama = amalgamator.from_module(module, cfg, use_command_line=True,
-                                  args=args)
+                                  args=args, progname=progname)
     ama.run()
     if ama.is_EF:
         print(f"EF objective = {ama.EF_Obj}")

@@ -1,0 +1,19 @@
+"""acopf3_cylinders — multistage DC-OPF with line outages (analog of
+the reference's examples/acopf3/ccopf_multistage.py driver).
+
+    python examples/acopf3_cylinders.py --branching-factors 2,2 \\
+        --lagrangian --xhatshuffle --max-iterations 30
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import acopf3
+
+
+def main(args=None):
+    return cylinders_main(acopf3, "acopf3_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

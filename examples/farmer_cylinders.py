@@ -13,19 +13,7 @@ from mpisppy_tpu.utils import config, vanilla
 
 
 def _parse_args(args=None):
-    cfg = config.Config()
-    cfg.popular_args()
-    cfg.ph_args()
-    cfg.two_sided_args()
-    cfg.fwph_args()
-    cfg.lagrangian_args()
-    cfg.lagranger_args()
-    cfg.xhatlooper_args()
-    cfg.xhatshuffle_args()
-    cfg.xhatxbar_args()
-    cfg.slammax_args()
-    cfg.slammin_args()
-    cfg.fixer_args()
+    cfg = standard_cfg()
     farmer.inparser_adder(cfg)
     cfg.parse_command_line("farmer_cylinders", args=args)
     return cfg
@@ -44,6 +32,10 @@ def main(args=None):
                          batch=batch)
     if cfg.get("fixer"):
         vanilla.add_fixer(hub, cfg)
+    if cfg.get("use_norm_rho_updater"):
+        vanilla.add_norm_rho(hub, cfg)
+    if cfg.get("mult_rho"):
+        vanilla.add_multi_rho(hub, cfg)
     spokes = vanilla.build_spokes(cfg, farmer.scenario_creator, None,
                                   names, batch=batch)
 

@@ -1,0 +1,19 @@
+"""gbd_cylinders — Ferguson-Dantzig aircraft allocation (analog of
+the reference's gbd usage in the sequential-sampling tests).
+
+    python examples/gbd_cylinders.py --num-scens 10 --lagrangian \\
+        --xhatshuffle --max-iterations 30
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import gbd
+
+
+def main(args=None):
+    return cylinders_main(gbd, "gbd_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -55,6 +55,15 @@ CORPUS = [
     ("apl1p_cylinders.py",
      "--num-scens 4 --max-iterations 30 --default-rho 1 "
      "--lagrangian --xhatshuffle"),
+    ("gbd_cylinders.py",
+     "--num-scens 10 --max-iterations 30 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+    ("usar_cylinders.py",
+     "--num-scens 3 --max-iterations 25 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+    ("acopf3_cylinders.py",
+     "--branching-factors 2,2 --max-iterations 30 --default-rho 5 "
+     "--lagrangian --xhatshuffle"),
 ]
 
 FAST = {"farmer_cylinders.py", "farmer_lshapedhub.py",

@@ -1,0 +1,19 @@
+"""usar_cylinders — urban search and rescue deployment (analog of the
+reference's examples/usar/wheel_spinner.py).
+
+    python examples/usar_cylinders.py --num-scens 3 --lagrangian \\
+        --xhatshuffle --max-iterations 25
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import usar
+
+
+def main(args=None):
+    return cylinders_main(usar, "usar_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -118,6 +118,13 @@ def gap_estimators(xhat_one, mname_or_module, solving_type="EF_2stage",
                 "multistage problems (reference ciutils.py:288)")
         n = num_scens - num_scens % ArRP
         npool = n // ArRP
+        if npool < 2:
+            # npool=0 would estimate on empty samples (nan/0 G) and
+            # hand callers a stopping certificate that was never
+            # computed; npool=1 has no sample std
+            raise ValueError(
+                f"gap_estimators: num_scens={num_scens} too small for "
+                f"ArRP={ArRP} pooling (need >= 2 per pool)")
         Gs, ss, zhs, zss, gobjs = [], [], [], [], []
         sub_seed = seed
         for _ in range(ArRP):

@@ -62,6 +62,12 @@ class IndepScens_SeqSampling(SeqSampling):
         history = []
         xhat = None
         G = s = None
+        # candidate-padding metadata depends only on the branching
+        # factors, not the sample seed — compute once, not per
+        # iteration (each _tree_batch materializes the full tensor)
+        meta_batch = self._tree_batch(self.branching_factors, self.seed)
+        K = meta_batch.num_nonants
+        stage_of = np.asarray(meta_batch.tree.stage_of)
         for k in range(1, self.max_iters + 1):
             # the reference forces kf_Gs = kf_xhat = 1 for multistage
             # (seqsampling.py:233-241): every sample is a fresh tree;
@@ -71,9 +77,6 @@ class IndepScens_SeqSampling(SeqSampling):
             seed += n
             # pad the stage-1 candidate to the full nonant layout for
             # evaluation (later stages stay free via upto_stage=1)
-            batch = self._tree_batch(self.branching_factors, seed)
-            K = batch.num_nonants
-            stage_of = np.asarray(batch.tree.stage_of)
             xhat = np.zeros(K)
             xhat[stage_of == 1] = xhat1
             vals = walking_tree_xhats(

@@ -49,8 +49,20 @@ def wind_profile(scennum, H, seed=91):
     return np.maximum(0.0, base + wiggle - 20.0)
 
 
-def build_batch(num_scens, H=6, n_units=None, seed=91, dtype=np.float64):
+def build_batch(num_scens, H=6, n_units=None, seed=91,
+                fleet_multiplier=1, dtype=np.float64):
+    """fleet_multiplier k replicates the 3-unit fleet k times with
+    seeded parameter jitter and scales demand to match — the scaling
+    axis of the reference's larger_uc instances (paperruns/larger_uc:
+    3..1000 wind scenarios on bigger systems)."""
     fleet = _FLEET if n_units is None else _FLEET[:n_units]
+    if fleet_multiplier > 1:
+        rng = np.random.RandomState(seed + 5)
+        reps = []
+        for k in range(fleet_multiplier):
+            jit = 1.0 + 0.1 * (rng.rand(len(fleet), 6) - 0.5)
+            reps.append(fleet * jit)
+        fleet = np.concatenate(reps, axis=0)
     G = len(fleet)
     S = num_scens
     Pmin, Pmax, ramp, cNL, cSU, cV = fleet.T
@@ -87,8 +99,9 @@ def build_batch(num_scens, H=6, n_units=None, seed=91, dtype=np.float64):
             A[:, r, uidx(g, h)] = -Pmin[g]
             row_lo[:, r] = 0.0
             r += 1
-    dem = demand_profile(H)
-    wind = np.stack([wind_profile(s, H, seed) for s in range(S)])
+    dem = demand_profile(H) * fleet_multiplier
+    wind = np.stack([wind_profile(s, H, seed)
+                     for s in range(S)]) * fleet_multiplier
     for h in range(H):                     # balance
         for g in range(G):
             A[:, r, pidx(g, h)] = 1.0
@@ -118,9 +131,17 @@ def build_batch(num_scens, H=6, n_units=None, seed=91, dtype=np.float64):
     assert r == M
 
     lb = np.zeros((S, N), dtype=dtype)
+    # implied finite boxes (farmer-style, provably inactive at some
+    # optimum): p <= Pmax follows from the forcing row with u <= 1;
+    # shedding beyond demand is pure cost.  All-finite boxes make the
+    # PDHG dual objective a valid Lagrangian bound at ANY iterate
+    # (spopt.valid_Ebound), so Lagrangian spokes need no certificates.
     ub = np.full((S, N), INF, dtype=dtype)
     ub[:, iu:isu] = 1.0
     ub[:, isu:ip] = 1.0
+    for g in range(G):
+        ub[:, ip + g * H: ip + (g + 1) * H] = Pmax[g]
+    ub[:, ish:] = 2.0 * dem.max()
 
     c = np.zeros((S, N), dtype=dtype)
     for g in range(G):
@@ -162,11 +183,109 @@ def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
 
 
+def commitment_candidate(batch, xbar_row, threshold=0.5):
+    """Integer-feasible first-stage candidate from a consensus vector:
+    commit unit-hours whose consensus weight exceeds `threshold`, then
+    DERIVE the startup s from the rounded u (s_h = max(0,
+    u_h - u_{h-1})) — fixing s at its averaged value alongside a
+    rounded u violates the startup-definition rows whenever rounding
+    flips a commitment.
+
+    Round-to-nearest (threshold 0.5) is usually terrible for UC: a
+    0.4-committed unit rounds OFF and its lost capacity is bought back
+    as load shedding at the penalty price.  Thresholds below 0.5
+    over-commit (cost: no-load + startup) instead of shedding; use
+    `commitment_candidates` to screen several thresholds in one
+    batched evaluation."""
+    vals = np.asarray(xbar_row, float).copy()
+    K = vals.size
+    GH = K // 2
+    u = (np.clip(vals[:GH], 0, 1) > threshold).astype(float)
+    return np.concatenate([u, _derive_startups(batch, u)])
+
+
+def commitment_candidates(batch, xbar_row,
+                          thresholds=(0.02, 0.1, 0.25, 0.5, 0.75)):
+    """(k, K) stack of threshold-commitment candidates — feed to
+    SPOpt.evaluate_candidates for one-launch speculative screening
+    (SURVEY.md §2.10)."""
+    return np.stack([commitment_candidate(batch, xbar_row, t)
+                     for t in thresholds])
+
+
+def _derive_startups(batch, u):
+    GH = u.size
+    H = _infer_H(batch, GH)
+    G = GH // H
+    s = np.zeros_like(u)
+    for g in range(G):
+        blk = slice(g * H, (g + 1) * H)
+        ub_ = u[blk]
+        s[blk][0] = ub_[0]
+        s[blk][1:] = np.maximum(0.0, ub_[1:] - ub_[:-1])
+    return s
+
+
+def one_opt_commitment(evaluator, batch, candidate, max_sweeps=4,
+                       flip_slots=None):
+    """Batched 1-opt local search on the commitment: each sweep
+    evaluates single unit-hour flips of the incumbent commitment in
+    ONE stacked launch (k candidates x S scenarios,
+    SPOpt.evaluate_candidates) and keeps the best improving flip.
+    Returns (candidate, value).  This is how the reference's slam/xhat
+    heuristics earn UC incumbents near the MIP optimum without a MIP
+    solver in the loop.
+
+    flip_slots: restrict the search to these u-slot indices (callers
+    pass the FRACTIONAL consensus slots — rounding is only ambiguous
+    there, and a full GH-slot sweep costs GH/|fractional| times more
+    for flips the consensus already decided)."""
+    cand = np.asarray(candidate, float).copy()
+    GH = cand.size // 2
+    if flip_slots is None:
+        flip_slots = np.arange(GH)
+    flip_slots = np.asarray(flip_slots, int)
+    val, feas = evaluator.evaluate_xhat(cand)
+    if not feas:
+        return cand, np.inf
+    for _ in range(max_sweeps):
+        flips = []
+        for j in flip_slots:
+            u = cand[:GH].copy()
+            u[j] = 1.0 - u[j]
+            flips.append(np.concatenate([u, _derive_startups(batch, u)]))
+        if not flips:
+            break
+        objs, feas_m = evaluator.evaluate_candidates(np.stack(flips))
+        ok = np.flatnonzero(feas_m)
+        if ok.size == 0:
+            break
+        j = int(ok[np.argmin(objs[ok])])
+        # certify the winning flip with the accurate evaluator
+        v2, f2 = evaluator.evaluate_xhat(flips[j])
+        if not f2 or v2 >= val - 1e-7 * (1 + abs(val)):
+            break
+        cand, val = flips[j], v2
+    return cand, val
+
+
+def _infer_H(batch, GH):
+    # nonant names are u[g,h] blocks, unit-major; recover H from names
+    names = batch.tree.nonant_names
+    hs = [int(n.split(",")[1].rstrip("]")) for n in names[:GH]
+          if n.startswith("u[")]
+    return (max(hs) + 1) if hs else GH
+
+
 def inparser_adder(cfg):
     cfg.num_scens_required()
     cfg.add_to_config("uc_hours", description="commitment horizon",
                       domain=int, default=6)
+    cfg.add_to_config("uc_fleet_multiplier",
+                      description="replicate the 3-unit fleet this "
+                      "many times (jittered)", domain=int, default=1)
 
 
 def kw_creator(options):
-    return {"H": options.get("uc_hours", 6)}
+    return {"H": options.get("uc_hours", 6),
+            "fleet_multiplier": options.get("uc_fleet_multiplier", 1)}

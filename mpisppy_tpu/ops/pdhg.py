@@ -275,9 +275,15 @@ class PDHGSolver:
     """
 
     def __init__(self, max_iters=20000, eps=1e-6, check_every=40,
-                 restart_every=4, omega0=1.0, use_pallas="auto",
+                 restart_every=16, omega0=1.0, use_pallas="auto",
                  pallas_tile=8, pallas_interpret=False):
-        # restart_every is in units of `check_every` inner iterations
+        # restart_every is in units of `check_every` inner iterations.
+        # Default 16 (=640 inner iterations per restart cycle):
+        # measured on the model corpus, every-4 restarts CYCLE on
+        # degenerate duals (unit commitment: 24/40 scenarios stuck at
+        # gap ~1 after 300k iters; at 16 all converge in 12k) and are
+        # ~2x slower on farmer; sizes/sslp/netdes/battery are
+        # insensitive (within ~2x of their small iteration counts).
         self.max_iters = int(max_iters)
         self.eps = float(eps)
         self.check_every = int(check_every)

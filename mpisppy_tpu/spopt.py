@@ -39,7 +39,7 @@ class SPOpt(SPBase):
             max_iters=int(o.get("pdhg_max_iters", 20000)),
             eps=float(o.get("pdhg_eps", 1e-6)),
             check_every=int(o.get("pdhg_check_every", 40)),
-            restart_every=int(o.get("pdhg_restart_every", 4)),
+            restart_every=int(o.get("pdhg_restart_every", 16)),
             use_pallas=o.get("pdhg_use_pallas", "auto"),
             pallas_tile=int(o.get("pdhg_pallas_tile", 8)),
             pallas_interpret=bool(o.get("pdhg_pallas_interpret", False)),

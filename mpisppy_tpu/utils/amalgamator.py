@@ -30,10 +30,11 @@ from .config import Config
 
 
 def from_module(mname, cfg, extraargs_fct=None, use_command_line=True,
-                args=None):
+                args=None, progname=None):
     """Build an Amalgamator for model module `mname` (reference
     amalgamator.py:139).  Declares the module's flags on cfg and
-    optionally parses the command line."""
+    optionally parses the command line (argparse prog = `progname`,
+    defaulting to the module name)."""
     m = mname if not isinstance(mname, str) else importlib.import_module(
         mname)
     for needed in ("scenario_names_creator", "inparser_adder",
@@ -48,8 +49,9 @@ def from_module(mname, cfg, extraargs_fct=None, use_command_line=True,
     if extraargs_fct is not None:
         extraargs_fct(cfg)
     if use_command_line:
-        cfg.parse_command_line(getattr(m, "__name__", "amalgamator"),
-                               args=args)
+        cfg.parse_command_line(
+            progname or getattr(m, "__name__", "amalgamator"),
+            args=args)
     return Amalgamator(cfg, m)
 
 

@@ -1,0 +1,107 @@
+"""gbd / usar / acopf3 model families (VERDICT r2 missing item 6):
+lowering correctness against the scipy/HiGHS oracle + algorithm
+smoke."""
+
+import dataclasses
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+from efcheck import ef_linprog, ef_milp  # noqa: E402
+
+from mpisppy_tpu.models import acopf3, gbd, usar  # noqa: E402
+from mpisppy_tpu.opt.ef import ExtensiveForm  # noqa: E402
+from mpisppy_tpu.opt.ph import PH  # noqa: E402
+
+OPTS = {"pdhg_eps": 1e-7, "pdhg_max_iters": 200000}
+
+
+def test_gbd_ef_matches_oracle():
+    b = gbd.build_batch(5)
+    ref, _ = ef_linprog(b, n_real=5)
+    ef = ExtensiveForm(dict(OPTS), b.tree.scen_names, batch=b)
+    ef.solve_extensive_form()
+    assert ef.get_objective_value() == pytest.approx(ref, rel=2e-4)
+    # reference protocol detail: demands drawn by RandomState(scennum)
+    d0 = gbd.scenario_demand(0)
+    assert d0.shape == (5,)
+    assert all(d0[r] in gbd.DEMANDS_EXT[r] for r in range(5))
+
+
+def test_gbd_ph_bounds_bracket():
+    b = gbd.build_batch(6)
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 40,
+             "convthresh": 1e-6, **OPTS},
+            list(b.tree.scen_names), batch=b)
+    conv, eobj, triv = ph.ph_main()
+    lag = ph.lagrangian_bound()
+    inner, feas = ph.evaluate_xhat(ph.root_xbar())
+    assert feas
+    assert lag <= inner + 1e-3 * abs(inner)
+
+
+def test_usar_lp_relaxation_matches_oracle():
+    b = usar.build_batch(2, time_horizon=4, num_sites=3)
+    ref, _ = ef_linprog(b, n_real=2)
+    ef = ExtensiveForm(dict(OPTS), b.tree.scen_names, batch=b)
+    ef.solve_extensive_form()
+    assert ef.get_objective_value() == pytest.approx(
+        ref, rel=2e-4, abs=1e-3)
+
+
+def test_usar_mip_saves_lives():
+    """Integer USAR via the LP dive: depots activate, teams deploy,
+    and the incumbent matches the HiGHS branch-and-cut oracle."""
+    from mpisppy_tpu.opt.mip import ExtensiveFormMIP
+    b = usar.build_batch(2, time_horizon=4, num_sites=3)
+    ref, _ = ef_milp(b, n_real=2, mip_rel_gap=1e-6)
+    ef = ExtensiveFormMIP(dict(OPTS), b.tree.scen_names, batch=b)
+    out = ef.solve_mip()
+    assert out["incumbent"] <= 0.0          # lives saved (negated)
+    assert out["incumbent"] == pytest.approx(ref, rel=5e-2, abs=0.51)
+    act = out["x"][:, :2]
+    assert np.allclose(act, np.round(act))
+
+
+def test_acopf3_multistage_ef():
+    b = acopf3.build_batch(branching_factors=(2, 2))
+    assert b.tree.num_nodes > 1             # true multistage tree
+    # LP part vs oracle (zero the quadratic cost; linprog can't QP)
+    b_lp = dataclasses.replace(b, qdiag=np.zeros_like(np.asarray(b.c)))
+    ref, _ = ef_linprog(b_lp, n_real=b.num_scens)
+    ef = ExtensiveForm(dict(OPTS), b.tree.scen_names, batch=b_lp)
+    ef.solve_extensive_form()
+    assert ef.get_objective_value() == pytest.approx(ref, rel=3e-4)
+    # QP path: quadratic generation cost can only increase the optimum
+    efq = ExtensiveForm(dict(OPTS), b.tree.scen_names, batch=b)
+    res = efq.solve_extensive_form()
+    assert bool(np.all(np.asarray(res.converged)))
+    assert efq.get_objective_value() >= ref - 1e-6 * abs(ref)
+
+
+def test_acopf3_outage_forces_zero_flow():
+    b = acopf3.build_batch(branching_factors=(7, 1), n_line=6)
+    ef = ExtensiveForm(dict(OPTS), b.tree.scen_names, batch=b)
+    res = ef.solve_extensive_form()
+    x = np.asarray(res.x)
+    # scenario with branch digit d>0 at stage 2 has line d-1 out: its
+    # stage-2 flow must be ~0
+    per = 3 + 5 + 6 + 2 * 5
+    for s in range(b.num_scens):
+        d = s % 7
+        if d > 0 and d - 1 < 6:
+            f = x[s, per + 3 + 5 + (d - 1)]
+            assert abs(f) < 1e-4, (s, d, f)
+
+
+def test_acopf3_ph_multistage_runs():
+    b = acopf3.build_batch(branching_factors=(2, 2))
+    ph = PH({"defaultPHrho": 5.0, "PHIterLimit": 25,
+             "convthresh": 1e-6, **OPTS},
+            list(b.tree.scen_names), batch=b)
+    conv, eobj, triv = ph.ph_main()
+    assert np.isfinite(eobj) and np.isfinite(triv)
+    assert triv <= eobj + 1e-3 * abs(eobj)

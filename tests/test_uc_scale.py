@@ -1,0 +1,78 @@
+"""UC at scale (VERDICT r2 item 9 / BASELINE stretch axis): 100 wind
+scenarios lowered in one batch, commitment recovered near the TRUE MIP
+optimum, plus a valid LP-based outer bound.
+
+Ground truth: scipy/HiGHS branch-and-cut on the same EF gives MIP
+optimum 24567.04 and LP relaxation 23077.82 — an inherent 6.1%
+integrality gap, so no LP-bound-based certificate can reach 1% here
+(the reference's UC runs close such gaps by solving MIP subproblems
+inside the Lagrangian spokes).  On the 1-core CPU test budget the
+threshold-screening pipeline lands within ~3% of the oracle optimum
+(measured 25255 = +2.8%); the batched 1-opt flip search
+(uc.one_opt_commitment, smoke-tested separately) is the TPU-scale
+refinement stage.
+
+Recovery pipeline (all batched): PH consensus -> threshold-commitment
+candidates screened in one stacked launch (speculative parallelism,
+SURVEY.md §2.10).
+"""
+
+import numpy as np
+
+from mpisppy_tpu.models import uc
+from mpisppy_tpu.opt.ph import PH
+
+ORACLE_MIP = 24567.04        # HiGHS branch-and-cut, mip_rel_gap 1e-4
+ORACLE_LP = 23077.82
+
+
+def test_uc_100_scenarios_near_optimum():
+    S = 100
+    b = uc.build_batch(S, H=6)
+    ph = PH({"defaultPHrho": 50.0, "PHIterLimit": 10,
+             "convthresh": 0.0, "pdhg_eps": 1e-6,
+             "superstep_eps": 1e-4, "lagrangian_eps": 1e-5,
+             "pdhg_max_iters": 200000},
+            [f"s{i}" for i in range(S)], batch=b)
+    ph.Iter0()
+    outer = ph.trivial_bound
+    for _ in range(10):
+        ph.ph_iteration()
+    outer = max(outer, ph.lagrangian_bound())
+
+    xbar = np.asarray(ph.state.xbar)[0]
+    cands = uc.commitment_candidates(b, xbar)   # default 5 thresholds
+    objs, feas = ph.evaluate_candidates(cands)
+    ok = np.flatnonzero(feas)
+    assert ok.size > 0
+    best = int(ok[np.argmin(objs[ok])])
+    inner, cfeas = ph.evaluate_xhat(cands[best])
+    assert cfeas
+
+    # incumbent within 3.5% of the true MIP optimum (measured +2.8%)
+    assert inner <= ORACLE_MIP * 1.035, inner
+    assert inner >= ORACLE_MIP * (1 - 1e-6)      # oracle is optimal
+    # valid outer bound: below the incumbent, consistent with the LP
+    assert outer <= inner
+    assert outer <= ORACLE_LP * 1.001
+    assert outer >= ORACLE_LP * 0.97
+
+
+def test_uc_one_opt_smoke():
+    """Batched 1-opt flip search improves (or retains) a deliberately
+    over-committed candidate on a small instance."""
+    S = 10
+    b = uc.build_batch(S, H=6)
+    ph = PH({"defaultPHrho": 50.0, "PHIterLimit": 3,
+             "convthresh": 0.0, "pdhg_eps": 1e-6,
+             "pdhg_max_iters": 100000},
+            [f"s{i}" for i in range(S)], batch=b)
+    ph.Iter0()
+    ph.ph_iteration()
+    all_on = uc.commitment_candidate(
+        b, np.ones(b.num_nonants), threshold=0.5)
+    v0, f0 = ph.evaluate_xhat(all_on)
+    assert f0
+    cand, v1 = uc.one_opt_commitment(ph, b, all_on, max_sweeps=2,
+                                     flip_slots=np.arange(6))
+    assert v1 <= v0 + 1e-6
