@@ -1,11 +1,18 @@
-"""PHTracker — per-iteration tracking to CSVs (reference:
+"""PHTracker — per-iteration tracking to CSVs and plots (reference:
 mpisppy/extensions/phtracker.py:14-510: bounds, gaps, xbars, duals,
-nonants, scenario costs as pandas DataFrames in per-cylinder folders).
+nonants, scenario costs as pandas DataFrames in per-cylinder folders,
+with optional matplotlib plots per tracked quantity).
 
 Options under options["phtracker_options"]:
     results_folder (default "phtracker_results")
-    track_bounds / track_xbars / track_duals / track_nonants /
-    track_scen_costs (all default True)
+    cylinder_name  (default from the hub/spoke class when running
+                    under a WheelSpinner, else "hub") — each cylinder
+    writes into results_folder/<cylinder_name>/ like the reference
+    track_bounds / track_gaps / track_xbars / track_duals /
+    track_nonants / track_scen_costs       (all default True)
+    plot_bounds / plot_gaps / plot_xbars / plot_duals /
+    plot_scen_costs                        (all default False) —
+    written as PNGs at post_everything via matplotlib when available
 """
 
 from __future__ import annotations
@@ -22,12 +29,32 @@ class PHTracker(Extension):
     def __init__(self, ph):
         super().__init__(ph)
         o = ph.options.get("phtracker_options") or {}
-        self.folder = o.get("results_folder", "phtracker_results")
+        self._root = o.get("results_folder", "phtracker_results")
+        self._name = o.get("cylinder_name")
+        self._folder = None
         self.track = {k: bool(o.get(f"track_{k}", True))
-                      for k in ("bounds", "xbars", "duals", "nonants",
-                                "scen_costs")}
-        os.makedirs(self.folder, exist_ok=True)
+                      for k in ("bounds", "gaps", "xbars", "duals",
+                                "nonants", "scen_costs")}
+        self.plot = {k: bool(o.get(f"plot_{k}", False))
+                     for k in ("bounds", "gaps", "xbars", "duals",
+                               "scen_costs")}
         self._files = {}
+
+    @property
+    def folder(self):
+        """Resolved lazily: extensions are constructed inside the opt
+        object's __init__, BEFORE the WheelSpinner attaches spcomm —
+        resolving the cylinder name there would put every cylinder in
+        the same 'hub' subfolder and interleave their CSVs."""
+        if self._folder is None:
+            name = self._name
+            if name is None:
+                spcomm = getattr(self.opt, "spcomm", None)
+                name = (type(spcomm).__name__ if spcomm is not None
+                        else "hub")
+            self._folder = os.path.join(self._root, str(name))
+            os.makedirs(self._folder, exist_ok=True)
+        return self._folder
 
     def _w(self, name, header):
         if name not in self._files:
@@ -40,18 +67,31 @@ class PHTracker(Extension):
             self._files[name] = (f, w)
         return self._files[name][1]
 
+    def _hub_bounds(self):
+        hub = getattr(self.opt, "spcomm", None)
+        ob = getattr(hub, "BestOuterBound", float("nan"))
+        ib = getattr(hub, "BestInnerBound", float("nan"))
+        return float(ob), float(ib)
+
     def _iteration_row(self):
         opt = self.opt
         st = opt.state
         it = int(st.it)
         K = opt.batch.num_nonants
         if self.track["bounds"]:
-            hub = getattr(opt, "spcomm", None)
-            ob = getattr(hub, "BestOuterBound", float("nan"))
-            ib = getattr(hub, "BestInnerBound", float("nan"))
+            ob, ib = self._hub_bounds()
             conv = float(st.conv)
             self._w("bounds", ["iteration", "outer", "inner", "conv"]
                     ).writerow([it, ob, ib, conv])
+        if self.track["gaps"]:
+            ob, ib = self._hub_bounds()
+            if np.isfinite(ob) and np.isfinite(ib) and abs(ib) > 0:
+                abs_gap = abs(ib - ob)
+                rel_gap = abs_gap / abs(ib)
+            else:
+                abs_gap = rel_gap = float("nan")
+            self._w("gaps", ["iteration", "abs_gap", "rel_gap"]
+                    ).writerow([it, abs_gap, rel_gap])
         if self.track["xbars"]:
             self._w("xbars", ["iteration"] + [f"x{k}" for k in range(K)]
                     ).writerow([it] + np.asarray(st.xbar[0]).tolist())
@@ -79,7 +119,39 @@ class PHTracker(Extension):
     def enditer(self):
         self._iteration_row()
 
+    # -- plotting (reference phtracker.py plot_* methods) ----------------
+    def _plot_csv(self, name, ylabel, series_limit=12):
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:                            # pragma: no cover
+            return
+        path = os.path.join(self.folder, f"{name}.csv")
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            rows = list(csv.reader(f))
+        if len(rows) < 2:
+            return
+        header, data = rows[0], np.array(
+            [[float(v) for v in r] for r in rows[1:]])
+        fig, ax = plt.subplots(figsize=(7, 4))
+        for j in range(1, min(data.shape[1], series_limit + 1)):
+            ax.plot(data[:, 0], data[:, j], label=header[j])
+        ax.set_xlabel("iteration")
+        ax.set_ylabel(ylabel)
+        ax.legend(fontsize=7, ncol=2)
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.folder, f"{name}.png"), dpi=100)
+        plt.close(fig)
+
     def post_everything(self):
         for f, _ in self._files.values():
             f.close()
         self._files = {}
+        for name, ylabel in (("bounds", "bound"), ("gaps", "gap"),
+                             ("xbars", "xbar"), ("duals", "|W| mean"),
+                             ("scen_costs", "scenario cost")):
+            if self.plot.get(name, False):
+                self._plot_csv(name, ylabel)

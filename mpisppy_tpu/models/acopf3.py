@@ -45,7 +45,9 @@ INF = float("inf")
 
 def _grid(n_bus, n_line, n_gen, seed):
     rng = np.random.RandomState(seed)
-    # ring + random chords
+    # ring + random chords; at most C(n_bus, 2) distinct lines exist,
+    # so cap the request or the chord loop would never terminate
+    n_line = min(n_line, n_bus * (n_bus - 1) // 2)
     lines = [(b, (b + 1) % n_bus) for b in range(n_bus)]
     while len(lines) < n_line:
         a, b = rng.randint(0, n_bus, 2)

@@ -340,27 +340,27 @@ class ExtensiveFormMIP(ExtensiveForm):
                         f"{int(np.sum(mask & (lb != ub)))} left, "
                         f"obj~{best[0]:.6g}")
 
-        # ---- Phase Z: gating binaries, costliest first -----------------
-        if gating.any():
-            coupled_dive(gating, "Z",
-                         weight=1.0 + np.abs(np.asarray(b.c, np.float64)),
-                         fixer=fix_gating)
-            # 1-opt refinement: the greedy decided each binary while
-            # later ones were still fractional (their setup cost
-            # amortized to ~nothing), so re-test every decision with
-            # ALL binaries integral — one warm consensus LP per flip
-            # (the continuous rest re-optimizes exactly).  Measured on
-            # sizes-3: recovers ~0.7% of objective the greedy leaves.
-            gcols = np.flatnonzero(np.any(gating, axis=0))
+        def refine_binaries(mask, fixer, phase):
+            """1-opt / 2-opt re-testing of fixed BINARY decisions with
+            all of them integral: the greedy decided each binary while
+            later ones were still fractional (their cost amortized to
+            ~nothing), so flips and open/close swaps are re-evaluated
+            by one warm consensus LP each (the continuous rest
+            re-optimizes exactly).  Measured: recovers ~0.7% on
+            sizes-3 (setup binaries) and ~11% on sslp_5_25_50
+            (facility-open nonants)."""
+            cols = np.flatnonzero(np.any(mask, axis=0))
+            if cols.size == 0:
+                return
+
+            def rep_scen(vi):
+                return int(np.flatnonzero(mask[:, vi])[0])
 
             def try_flip(flips):
-                """Evaluate flipping the given [(si, vi, newval)]
-                jointly; accept (mutating lb/ub + state) if the
-                relaxation improves.  Returns True on accept."""
                 cur = float(np.sum(np.asarray(state["res"].obj)))
                 lb2, ub2 = lb.copy(), ub.copy()
                 for si, vi, nv in flips:
-                    fix_gating(lb2, ub2, si, vi, nv)
+                    fixer(lb2, ub2, si, vi, nv)
                 cand = self._lp(c_s, lb2.astype(dt), ub2.astype(dt),
                                 x0=state["res"].x, y0=state["res"].y)
                 state["lp_solves"] += 1
@@ -370,53 +370,61 @@ class ExtensiveFormMIP(ExtensiveForm):
                 if obj >= cur - 1e-7 * (1 + abs(cur)):
                     return False
                 for si, vi, nv in flips:
-                    fix_gating(lb, ub, si, vi, nv)
+                    fixer(lb, ub, si, vi, nv)
                 state["res"] = cand
                 if verbose:
-                    global_toc(f"MIP dive Z {len(flips)}-opt: "
+                    global_toc(f"MIP dive {phase} {len(flips)}-opt: "
                                f"{[(v, nv) for _, v, nv in flips]}, "
                                f"obj~{obj:.6g}")
                 return True
 
-            def rep_scen(vi):
-                return int(np.flatnonzero(gating[:, vi])[0])
-
             improved = True
             sweep = 0
-            budget = [12 * max(len(gcols), 1)]
-            while improved and sweep < 4 and budget[0] > 0:
+            budget = 12 * max(cols.size, 1)
+            while improved and sweep < 4 and budget > 0:
                 improved = False
                 sweep += 1
                 # 1-opt: re-test each decision with all binaries fixed
-                for vi in gcols:
+                for vi in cols:
                     si = rep_scen(vi)
-                    if lb[si, vi] != ub[si, vi] or budget[0] <= 0:
+                    if lb[si, vi] != ub[si, vi] or budget <= 0:
                         continue
-                    budget[0] -= 1
+                    budget -= 1
                     if try_flip([(si, vi, 1.0 - lb[si, vi])]):
                         improved = True
                 # 2-opt: open/close swaps single flips cannot reach
                 # (closing alone is infeasible, opening alone is pure
                 # cost; the swap can still be net cheaper)
                 if not improved:
-                    for vi in gcols:
+                    for vi in cols:
                         si = rep_scen(vi)
                         if lb[si, vi] != ub[si, vi] or lb[si, vi] != 1:
                             continue
-                        for vj in gcols:
+                        for vj in cols:
                             sj = rep_scen(vj)
                             if vj == vi or lb[sj, vj] != ub[sj, vj] \
-                                    or lb[sj, vj] != 0 or budget[0] <= 0:
+                                    or lb[sj, vj] != 0 or budget <= 0:
                                 continue
-                            budget[0] -= 1
+                            budget -= 1
                             if try_flip([(si, vi, 0.0),
                                          (sj, vj, 1.0)]):
                                 improved = True
                                 break
                         if improved:
                             break
+
+        # ---- Phase Z: gating binaries, costliest first -----------------
+        if gating.any():
+            coupled_dive(gating, "Z",
+                         weight=1.0 + np.abs(np.asarray(b.c, np.float64)),
+                         fixer=fix_gating)
+            refine_binaries(gating, fix_gating, "Z")
         # ---- Phase A: integer nonants over the consensus EF ------------
-        coupled_dive(imask & na_cols[None, :], "A")
+        na_int = imask & na_cols[None, :]
+        coupled_dive(na_int, "A")
+        na_bin = na_int & is_binary
+        if na_bin.any():
+            refine_binaries(na_bin, fix_at, "A")
         res = state["res"]
         lp_solves = state["lp_solves"]
         rounds = state["rounds"]

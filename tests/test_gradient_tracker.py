@@ -57,12 +57,19 @@ def test_grad_csv_roundtrip(tmp_path):
 
 def test_phtracker_writes(tmp_path):
     folder = os.path.join(tmp_path, "trk")
-    ph = make_ph(opts={"phtracker_options": {"results_folder": folder}},
-                 extensions=PHTracker)
+    ph = make_ph(opts={"phtracker_options": {
+        "results_folder": folder, "plot_bounds": True,
+        "plot_xbars": True}}, extensions=PHTracker)
     ph.ph_main()
-    for name in ("bounds", "xbars", "duals", "nonants", "scen_costs"):
-        path = os.path.join(folder, f"{name}.csv")
+    # per-cylinder folder layout (reference phtracker.py): no spcomm
+    # here, so the cylinder name defaults to "hub"
+    cyl = os.path.join(folder, "hub")
+    for name in ("bounds", "gaps", "xbars", "duals", "nonants",
+                 "scen_costs"):
+        path = os.path.join(cyl, f"{name}.csv")
         assert os.path.exists(path)
         with open(path) as f:
             lines = f.read().strip().splitlines()
         assert len(lines) >= 3   # header + iter0 + iterations
+    for name in ("bounds", "xbars"):
+        assert os.path.exists(os.path.join(cyl, f"{name}.png"))

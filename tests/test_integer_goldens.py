@@ -73,6 +73,25 @@ def test_sizes_lp_relaxation_matches_oracle():
     assert float(np.asarray(b.row_hi)[0, -1]) == 200000.0
 
 
+def test_sslp_siplib_golden_slow():
+    """The published SIPLIB sslp_5_25_50 optimum is -121.6; the HiGHS
+    oracle on our embedded instance data reproduces it exactly
+    (efcheck.ef_milp: -121.60, LP relaxation -160.06).  The LP dive
+    must find an integer-feasible incumbent within 2 sig figs
+    (round_pos_sig -> -120.0)."""
+    from mpisppy_tpu.models import sslp
+    b = sslp.build_batch(50, instance="sslp_5_25")
+    ef = ExtensiveFormMIP({"pdhg_eps": 1e-6, "pdhg_max_iters": 200000},
+                          b.tree.scen_names, batch=b, mesh=_mesh1())
+    out = ef.solve_mip()
+    assert -round_pos_sig(-out["incumbent"], 2) == -120.0
+    assert out["incumbent"] >= -121.6 - 1e-6     # oracle is optimal
+    assert out["bound"] <= out["incumbent"]
+    imask = np.asarray(ef.batch.integer_mask)
+    xi = out["x"][imask]
+    assert np.allclose(xi, np.round(xi))
+
+
 def test_farmer_integer_mip_dive():
     """Integer farmer (acreage integrality, reference farmer.py
     use_integer): the dive returns an integral incumbent within a few
