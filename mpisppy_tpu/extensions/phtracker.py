@@ -85,9 +85,10 @@ class PHTracker(Extension):
                     ).writerow([it, ob, ib, conv])
         if self.track["gaps"]:
             ob, ib = self._hub_bounds()
-            if np.isfinite(ob) and np.isfinite(ib) and abs(ib) > 0:
+            if np.isfinite(ob) and np.isfinite(ib):
                 abs_gap = abs(ib - ob)
-                rel_gap = abs_gap / abs(ib)
+                rel_gap = (abs_gap / abs(ib) if abs(ib) > 0
+                           else float("nan"))
             else:
                 abs_gap = rel_gap = float("nan")
             self._w("gaps", ["iteration", "abs_gap", "rel_gap"]

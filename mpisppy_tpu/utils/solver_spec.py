@@ -1,0 +1,84 @@
+"""solver_spec — hierarchical solver-option resolution (reference:
+mpisppy/utils/solver_spec.py:34 solver_specification, which cascades
+`{root}_solver_name` / `{root}_solver_options` prefixes so each
+cylinder can carry its own solver configuration).
+
+There are no external solver NAMES here (the kernel is in-process),
+so the cascade resolves KERNEL knobs instead: for an ordered list of
+roots (e.g. ["lagrangian", ""]) the first root with any
+`{root}_solver_*` setting wins and its knobs are returned as the
+optimizer-option dict (pdhg_eps / pdhg_max_iters / pdhg_check_every /
+pdhg_restart_every), falling back to the unprefixed values.  Options
+may also be given as ONE string of space-separated key=value pairs
+(`{root}_solver_options`, the reference's convention, parsed by
+`option_string_to_dict`).
+"""
+
+from __future__ import annotations
+
+KNOBS = ("eps", "max_iters", "check_every", "restart_every")
+
+
+def option_string_to_dict(ostr):
+    """'eps=1e-6 max_iters=30000' -> {'eps': 1e-6, 'max_iters': 30000}
+    (reference sputils.py:551 option_string_to_dict; values parsed as
+    int, then float, then left as strings)."""
+    if ostr is None or ostr == "":
+        return None
+    out = {}
+    for tok in str(ostr).split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            for cast in (int, float):
+                try:
+                    v = cast(v)
+                    break
+                except ValueError:
+                    continue
+        else:
+            k, v = tok, True
+        out[k] = v
+    return out
+
+
+def solver_specification(cfg, prefix="", name_required=False):
+    """Resolve kernel options through a prefix cascade.
+
+    Args:
+        cfg: a Config or plain dict of options.
+        prefix: one root string or an ordered list (first root with
+            any `{root}_solver_*` key wins; "" = the unprefixed
+            options).
+        name_required: kept for reference-signature parity; raises if
+            no root matched and this is True.
+
+    Returns:
+        (sroot, options) — the winning root (None if none matched)
+        and a dict of optimizer options ({"pdhg_eps": ..., ...}).
+    """
+    roots = list(prefix) if isinstance(prefix, (list, tuple)) else [prefix]
+    get = cfg.get if hasattr(cfg, "get") else cfg.__getitem__
+
+    def keyed(root, knob):
+        return (f"solver_{knob}" if root == ""
+                else f"{root}_solver_{knob}")
+
+    checked = []
+    for sroot in roots:
+        hits = {}
+        for knob in KNOBS:
+            k = keyed(sroot, knob)
+            checked.append(k)
+            v = get(k) if hasattr(cfg, "get") else cfg.get(k)
+            if v is not None:
+                hits[f"pdhg_{knob}"] = v
+        ostr = get(keyed(sroot, "options"))
+        if ostr:
+            for k, v in (option_string_to_dict(ostr) or {}).items():
+                hits[k if k.startswith("pdhg_") else f"pdhg_{k}"] = v
+        if hits:
+            return sroot, hits
+    if name_required:
+        raise RuntimeError(
+            f"no solver specification found; checked {checked}")
+    return None, {}

@@ -34,8 +34,17 @@ def shared_options(cfg):
 def _opt_kwargs(cfg, scenario_creator, scenario_denouement,
                 all_scenario_names, scenario_creator_kwargs=None,
                 batch=None, rho_setter=None, all_nodenames=None,
-                extensions=None, extension_kwargs=None, extra=None):
+                extensions=None, extension_kwargs=None, extra=None,
+                solver_root=None):
     opts = shared_options(cfg)
+    if solver_root:
+        # per-cylinder kernel-knob cascade (reference
+        # utils/solver_spec.py: each spoke may carry its own
+        # {root}_solver_* configuration)
+        from .solver_spec import solver_specification
+        _, sopts = solver_specification(cfg, [solver_root, ""],
+                                        name_required=False)
+        opts.update(sopts)
     if extra:
         opts.update(extra)
     kw = dict(options=opts,
@@ -114,7 +123,12 @@ def lshaped_hub(cfg, scenario_creator, scenario_denouement,
 def _spoke(spoke_class, opt_class, cfg, scenario_creator,
            scenario_denouement, all_scenario_names,
            scenario_creator_kwargs=None, batch=None, extra=None,
-           spoke_options=None, all_nodenames=None):
+           spoke_options=None, all_nodenames=None, solver_root=None):
+    if solver_root is None:
+        # "LagrangianOuterBound" -> "lagrangian", etc.
+        solver_root = spoke_class.__name__.replace(
+            "OuterBound", "").replace("InnerBound", "").replace(
+            "Heuristic", "").lower()
     return {
         "spoke_class": spoke_class,
         "spoke_kwargs": {"options": spoke_options or {}},
@@ -122,7 +136,8 @@ def _spoke(spoke_class, opt_class, cfg, scenario_creator,
         "opt_kwargs": _opt_kwargs(
             cfg, scenario_creator, scenario_denouement,
             all_scenario_names, scenario_creator_kwargs, batch,
-            all_nodenames=all_nodenames, extra=extra),
+            all_nodenames=all_nodenames, extra=extra,
+            solver_root=solver_root),
     }
 
 
@@ -130,9 +145,11 @@ def fwph_spoke(cfg, scenario_creator, scenario_denouement,
                all_scenario_names, scenario_creator_kwargs=None,
                batch=None):
     """Reference cfg_vanilla.py:277."""
+    # explicit root: the derived name would be 'frankwolfe', but the
+    # flag convention (fwph_args, fwph_solver_*) uses 'fwph'
     return _spoke(FrankWolfeOuterBound, FWPH, cfg, scenario_creator,
                   scenario_denouement, all_scenario_names,
-                  scenario_creator_kwargs, batch)
+                  scenario_creator_kwargs, batch, solver_root="fwph")
 
 
 def lagrangian_spoke(cfg, scenario_creator, scenario_denouement,

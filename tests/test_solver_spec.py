@@ -1,0 +1,38 @@
+"""solver_spec cascade tests (reference analog:
+mpisppy/utils/solver_spec.py usage in vanilla/seqsampling)."""
+
+import pytest
+
+from mpisppy_tpu.utils.solver_spec import (option_string_to_dict,
+                                           solver_specification)
+
+
+def test_option_string_parsing():
+    d = option_string_to_dict("eps=1e-6 max_iters=30000 flag")
+    assert d == {"eps": 1e-6, "max_iters": 30000, "flag": True}
+    assert option_string_to_dict(None) is None
+
+
+def test_prefix_cascade():
+    cfg = {"lagrangian_solver_eps": 1e-5, "solver_eps": 1e-7,
+           "solver_max_iters": 40000}
+    root, opts = solver_specification(cfg, ["lagrangian", ""])
+    assert root == "lagrangian"
+    assert opts == {"pdhg_eps": 1e-5}
+    root, opts = solver_specification(cfg, ["fwph", ""])
+    assert root == ""
+    assert opts == {"pdhg_eps": 1e-7, "pdhg_max_iters": 40000}
+
+
+def test_options_string_root():
+    cfg = {"ef_solver_options": "eps=1e-8 restart_every=32"}
+    root, opts = solver_specification(cfg, ["ef", ""])
+    assert root == "ef"
+    assert opts == {"pdhg_eps": 1e-8, "pdhg_restart_every": 32}
+
+
+def test_name_required_raises():
+    with pytest.raises(RuntimeError):
+        solver_specification({}, ["ph"], name_required=True)
+    root, opts = solver_specification({}, ["ph"])
+    assert root is None and opts == {}
