@@ -30,22 +30,51 @@ Prints ONE json line:
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 
+def _accelerator_alive(timeout_s=90):
+    """Probe the accelerator backend in a SUBPROCESS with a timeout.
+
+    The TPU plugin's device tunnel can wedge so that the first
+    jax.devices() call blocks forever (observed: a dead axon tunnel
+    hangs backend init even under JAX_PLATFORMS=cpu unless the plugin
+    is deregistered first).  A hung bench records nothing; a CPU
+    fallback records an honest number with "device": "cpu"."""
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        return False
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return r.returncode == 0 and "cpu" not in r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def main():
     from mpisppy_tpu.utils.platform import ensure_cpu_backend
-    ensure_cpu_backend()
+    if not _accelerator_alive():
+        ensure_cpu_backend(force=True)
+    else:
+        ensure_cpu_backend()
     import jax
 
     from mpisppy_tpu.models import farmer
     from mpisppy_tpu.opt.ph import PH
 
-    S = int(os.environ.get("BENCH_SCENS", 1000))
-    mult = int(os.environ.get("BENCH_MULT", 10))
     on_tpu = jax.devices()[0].platform != "cpu"
+    # full size on the accelerator; a smaller default on the CPU
+    # fallback so a dead tunnel still yields a finished run (explicit
+    # BENCH_SCENS always wins)
+    S = int(os.environ.get("BENCH_SCENS", 1000 if on_tpu else 250))
+    mult = int(os.environ.get("BENCH_MULT", 10))
 
     b = farmer.build_batch(S, crops_multiplier=mult,
                            dtype=np.float32 if on_tpu else np.float64)
@@ -92,7 +121,12 @@ def main():
                 else None),
         "kernel_tflops": round(stats["flops"] / 1e12, 3),
         "device": stats["device"],
+        "scens": S,
+        "crops_multiplier": mult,
     }
+    if S != 1000:
+        extra["note_size"] = (f"reduced size (S={S}): accelerator "
+                              "unavailable, CPU fallback")
     if gap > 0.01:
         print(json.dumps({
             "metric": "farmer1000_ph_seconds_to_1pct_gap",
@@ -102,11 +136,14 @@ def main():
         return
 
     baseline_s = 2939.1  # Gurobi barrier, farmer EF-1000 (BASELINE.md)
+    # the baseline is the 1000-scenario instance: claim a ratio only
+    # when solving that size (the CPU-fallback reduced run reports 0)
+    vs = round(baseline_s / wall, 2) if S == 1000 else 0
     print(json.dumps({
         "metric": "farmer1000_ph_seconds_to_1pct_gap",
         "value": round(wall, 3),
         "unit": "s",
-        "vs_baseline": round(baseline_s / wall, 2),
+        "vs_baseline": vs,
         "gap": round(float(gap), 5),
         **extra}))
 
