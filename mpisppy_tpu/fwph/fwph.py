@@ -27,7 +27,9 @@ TPU-native restructuring:
     outer bound (fwph.py:142-208) for free.
 
 API mirror: FWPH(options, ...).fwph_main() -> (conv, Eobj, dual_bound).
-Options: FW_iter_limit (SDM rounds/outer pass, default 2), column_bank
+Options: FW_iter_limit (SDM rounds/outer pass, default 2), FW_eps
+(Frank-Wolfe gap tolerance ending an SDM pass early, default 1e-6 —
+the reference SDM's Gamma stopping test, fwph.py:268-287), column_bank
 (capacity T, default 16), plus PH options.
 """
 
@@ -47,6 +49,7 @@ class FWPH(PHBase):
         super().__init__(*args, **kwargs)
         o = self.options
         self.fw_iter_limit = int(o.get("FW_iter_limit", 2))
+        self.fw_eps = float(o.get("FW_eps", 1e-6))
         self.T = int(o.get("column_bank", 16))
         b = self.batch
         S, N = b.num_scens, b.num_vars
@@ -59,6 +62,7 @@ class FWPH(PHBase):
             eps=float(o.get("pdhg_eps", 1e-6)))
         self.dual_bound = None         # best (max for min-problems) so far
         self._dual_bounds = []         # sequence, one per outer pass
+        self.sdm_early_stops = 0       # SDM passes ended by the Gamma test
 
     # -- column management -------------------------------------------------
     def _add_columns(self, x_new):
@@ -157,6 +161,20 @@ class FWPH(PHBase):
                 res = self.solver.solve(
                     self.prep, c_eff, b.qdiag, self.lb_eff,
                     self.ub_eff, obj_const=b.obj_const)
+                # SDM Gamma test (reference fwph.py:268-287): the
+                # Frank-Wolfe gap c_lin.(x_hull - x_vertex) bounds the
+                # hull QP's remaining improvement; when the expected
+                # gap is below FW_eps no vertex can improve the hull
+                # and the SDM pass ends early
+                gap_s = np.einsum(
+                    "sn,sn->s", np.asarray(c_eff),
+                    x_qp - np.asarray(res.x))
+                fw_gap = float(np.asarray(b.prob) @ gap_s)
+                scale = 1.0 + abs(float(self.Eobjective(
+                    b.objective(jnp.asarray(x_qp)))))
+                if fw_gap <= self.fw_eps * scale:
+                    self.sdm_early_stops += 1
+                    break
             self._add_columns(np.asarray(res.x))
             x_qp, lam = self._hull_qp(W, xbar)
             self._lam = lam

@@ -60,3 +60,17 @@ def test_fwph_spoke_with_ph_hub():
     ws = WheelSpinner(hub, [spoke]).spin()
     assert ws.BestOuterBound <= -108388.0
     assert ws.BestOuterBound >= -115406.0
+
+
+def test_fw_gap_early_stopping():
+    """The SDM Gamma test (reference fwph.py:268-287) must end inner
+    passes early once the hull contains the vertex optimum."""
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.fwph.fwph import FWPH
+    b = farmer.build_batch(3)
+    fw = FWPH({"defaultPHrho": 1.0, "PHIterLimit": 10,
+               "convthresh": 1e-6, "pdhg_eps": 1e-7,
+               "FW_iter_limit": 4, "FW_eps": 1e-5},
+              list(b.tree.scen_names), batch=b)
+    fw.fwph_main()
+    assert fw.sdm_early_stops > 0
