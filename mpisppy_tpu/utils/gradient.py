@@ -49,11 +49,15 @@ def find_rho(opt, order_stat=0.5, rel_bound=1e3, x=None):
     return np.maximum(rho, 1e-6)
 
 
+def _nonant_names(opt, count):
+    return opt.batch.tree.nonant_names or tuple(
+        str(k) for k in range(count))
+
+
 def write_grad_cost(path, opt, x=None):
     """CSV: scenario, varname, gradient (reference gradient.py CSV)."""
     g = grad_cost(opt, x=x)
-    names = opt.batch.tree.nonant_names or tuple(
-        str(k) for k in range(g.shape[1]))
+    names = _nonant_names(opt, g.shape[1])
     scen = opt.batch.tree.scen_names or tuple(
         str(s) for s in range(g.shape[0]))
     with open(path, "w", newline="") as f:
@@ -65,9 +69,7 @@ def write_grad_cost(path, opt, x=None):
 
 def read_grad_cost(path, opt):
     g = np.zeros((opt.batch.num_scens, opt.batch.num_nonants))
-    names = {n: k for k, n in enumerate(
-        opt.batch.tree.nonant_names
-        or tuple(str(k) for k in range(g.shape[1])))}
+    names = {n: k for k, n in enumerate(_nonant_names(opt, g.shape[1]))}
     scen = {n: s for s, n in enumerate(
         opt.batch.tree.scen_names
         or tuple(str(s) for s in range(g.shape[0])))}
@@ -76,3 +78,82 @@ def read_grad_cost(path, opt):
             if len(row) == 3 and row[0] in scen and row[1] in names:
                 g[scen[row[0]], names[row[1]]] = float(row[2])
     return g
+
+
+# -- rho CSV round-trip (reference utils/rho_utils.py rhos_to_csv /
+#    rho_list_from_csv: persist per-variable rhos so a later run can
+#    start from them — the file format the CLI below emits) -------------
+
+def write_rho(path, opt, rho):
+    """CSV: varname, rho (one row per nonant slot; (K,) or (S, K)
+    input — per-scenario rhos are written as their scenario-0 row,
+    matching the reference's per-variable file format)."""
+    rho = np.asarray(rho)
+    if rho.ndim == 2:
+        rho = rho[0]
+    names = _nonant_names(opt, rho.size)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["varname", "rho"])
+        for k in range(rho.size):
+            w.writerow([names[k], rho[k]])
+
+
+def read_rho(path, opt):
+    """(K,) rho vector from a write_rho CSV."""
+    names = {n: k for k, n in enumerate(
+        _nonant_names(opt, opt.batch.num_nonants))}
+    rho = np.ones(opt.batch.num_nonants)
+    with open(path, newline="") as f:
+        for row in csv.reader(f):
+            if len(row) == 2 and row[0] in names:
+                rho[names[row[0]]] = float(row[1])
+    return rho
+
+
+# -- standalone CLI (reference utils/gradient.py / find_rho.py __main__
+#    surfaces: compute grad costs + rhos for a model module and write
+#    the CSVs that Gradient_extension and WXBar warm starts consume) ----
+
+def main(args=None):
+    """python -m mpisppy_tpu.utils.gradient --module <model module>
+    --num-scens N [--grad-order-stat q] [--grad-cost-file F]
+    [--rho-file F]
+    """
+    from ..opt.ph import PH
+    from .amalgamator import from_module
+    from .config import Config
+
+    cfg = Config()
+    cfg.popular_args()
+    cfg.ph_args()
+    cfg.gradient_args()
+    cfg.add_to_config("module", "model module name (e.g. "
+                      "mpisppy_tpu.models.farmer)", str, None)
+    cfg.add_to_config("grad_cost_file", "gradient CSV output", str,
+                      "grad_cost.csv")
+    cfg.add_to_config("rho_file", "rho CSV output", str, "rhos.csv")
+    import importlib
+    known, _ = cfg.create_parser("gradient").parse_known_args(args)
+    if not known.module:
+        cfg.create_parser("gradient").error(
+            "--module is required (e.g. mpisppy_tpu.models.farmer)")
+    m = importlib.import_module(known.module)
+    ama = from_module(m, cfg, use_command_line=True, args=args,
+                      progname="gradient")
+    batch, names, creator, ckw = ama._make_batch_and_names()
+    ph = PH(cfg.options_dict(), names, batch=batch,
+            scenario_creator=creator, scenario_creator_kwargs=ckw)
+    ph.Iter0()
+    write_grad_cost(cfg["grad_cost_file"], ph)
+    rho = find_rho(ph, order_stat=cfg.get("grad_order_stat", 0.5),
+                   rel_bound=cfg.get("grad_rho_relative_bound", 1e3))
+    write_rho(cfg["rho_file"], ph, rho)
+    print(f"wrote {cfg['grad_cost_file']} and {cfg['rho_file']} "
+          f"({rho.size} nonant slots)")
+
+
+if __name__ == "__main__":      # pragma: no cover — CLI surface
+    from .platform import ensure_cpu_backend
+    ensure_cpu_backend()
+    main()

@@ -77,3 +77,14 @@ def test_phtracker_writes(tmp_path):
     pytest.importorskip("matplotlib")
     for name in ("bounds", "xbars"):
         assert os.path.exists(os.path.join(cyl, f"{name}.png"))
+
+
+def test_rho_csv_roundtrip(tmp_path):
+    from mpisppy_tpu.utils import gradient
+    ph = make_ph()
+    ph.Iter0()      # all find_rho needs (matches the sibling tests)
+    rho = gradient.find_rho(ph)
+    p = os.path.join(tmp_path, "rhos.csv")
+    gradient.write_rho(p, ph, rho)
+    back = gradient.read_rho(p, ph)
+    assert np.allclose(back, rho, rtol=1e-6)
