@@ -63,6 +63,8 @@ class FWPH(PHBase):
         self.dual_bound = None         # best (max for min-problems) so far
         self._dual_bounds = []         # sequence, one per outer pass
         self.sdm_early_stops = 0       # SDM passes ended by the Gamma test
+        # Gamma test is only a valid FW certificate for linear models
+        self._qdiag_zero = not bool(np.any(np.asarray(b.qdiag) != 0))
 
     # -- column management -------------------------------------------------
     def _add_columns(self, x_new):
@@ -165,16 +167,21 @@ class FWPH(PHBase):
                 # Frank-Wolfe gap c_lin.(x_hull - x_vertex) bounds the
                 # hull QP's remaining improvement; when the expected
                 # gap is below FW_eps no vertex can improve the hull
-                # and the SDM pass ends early
-                gap_s = np.einsum(
-                    "sn,sn->s", np.asarray(c_eff),
-                    x_qp - np.asarray(res.x))
-                fw_gap = float(np.asarray(b.prob) @ gap_s)
-                scale = 1.0 + abs(float(self.Eobjective(
-                    b.objective(jnp.asarray(x_qp)))))
-                if fw_gap <= self.fw_eps * scale:
-                    self.sdm_early_stops += 1
-                    break
+                # and the SDM pass ends early.  Valid only for LINEAR
+                # subproblems: with a model quadratic (qdiag != 0) the
+                # solve above includes b.qdiag, so res.x is not the
+                # linear-subproblem minimizer and the quantity is not a
+                # Frank-Wolfe gap — skip the early stop there.
+                if self._qdiag_zero:
+                    gap_s = np.einsum(
+                        "sn,sn->s", np.asarray(c_eff),
+                        x_qp - np.asarray(res.x))
+                    fw_gap = float(np.asarray(b.prob) @ gap_s)
+                    scale = 1.0 + abs(float(self.Eobjective(
+                        b.objective(jnp.asarray(x_qp)))))
+                    if fw_gap <= self.fw_eps * scale:
+                        self.sdm_early_stops += 1
+                        break
             self._add_columns(np.asarray(res.x))
             x_qp, lam = self._hull_qp(W, xbar)
             self._lam = lam

@@ -171,13 +171,24 @@ class ExtensiveFormMIP(ExtensiveForm):
                 members = node_of[:, k] == node_of[si, k]
                 lb_a[members, vi] = ub_a[members, vi] = val
 
-        res = self._lp(c_s, lb.astype(dt), ub.astype(dt))
-        if not self._feasible(res, tol):
+        # the REPORTED outer bound comes from the root relaxation under
+        # the TRUE c: the perturbed-c dual objective is only valid for
+        # the original problem up to O(perturb)*|c.x|, which could in
+        # principle exceed the true optimum by that epsilon
+        res_true = self._lp(np.asarray(b.c, dt), lb.astype(dt),
+                            ub.astype(dt))
+        if not self._feasible(res_true, tol):
             raise RuntimeError("EF LP relaxation infeasible/unsolved")
-        root_bound = float(np.sum(np.asarray(res.dual_obj)))
+        root_bound = float(np.sum(np.asarray(res_true.dual_obj)))
+        # the dive itself runs on the perturbed c_s (tie-breaking);
+        # warm-started from the true-c vertex this re-solve is cheap
+        res = self._lp(c_s, lb.astype(dt), ub.astype(dt),
+                       x0=res_true.x, y0=res_true.y)
+        if not self._feasible(res, tol):
+            res = res_true
 
         max_rounds = max_rounds or (int(np.sum(imask)) + 20)
-        state = {"res": res, "lp_solves": 1, "rounds": 0}
+        state = {"res": res, "lp_solves": 2, "rounds": 0}
 
         # gating binaries: binary b loosens row m for other columns when
         # raising b raises the slack (A[s,m,b] < 0 against a finite hi,
@@ -195,13 +206,29 @@ class ExtensiveFormMIP(ExtensiveForm):
         # variable's support indicator at any optimum, so its value is
         # common to the gated nonant's whole tree node: map each gating
         # column to the first nonant slot it gates and broadcast fixes
-        # over that node's members (cuts the phase-Z round count by S)
+        # over that node's members (cuts the phase-Z round count by S).
+        # Soundness requires the loosening rows to couple the binary to
+        # nonant columns EXCLUSIVELY — if those rows also involve
+        # scenario-local columns, the support-indicator equality is not
+        # implied and a broadcast could cut off the optimum, so such a
+        # binary is fixed per-scenario instead.  Broadcasting also
+        # requires every gated nonant slot to share one node structure
+        # (so "the node's members" is well-defined).
         gate_k = {}
         for j in np.flatnonzero(np.any(gating, axis=0)):
             rows_m = np.any(loosens[:, :, j], axis=0)        # (M,)
-            touched = np.any(A_np[:, rows_m][:, :, na] != 0, axis=(0, 1))
-            if touched.any():
-                gate_k[int(j)] = int(np.flatnonzero(touched)[0])
+            cols_touched = np.any(A_np[:, rows_m, :] != 0,
+                                  axis=(0, 1))               # (N,)
+            cols_touched[j] = False
+            if not (cols_touched & na_cols).any():
+                continue
+            if (cols_touched & ~na_cols).any():
+                continue                  # scenario-local coupling
+            ks = [col_to_k[int(cc)]
+                  for cc in np.flatnonzero(cols_touched & na_cols)]
+            if all(np.array_equal(node_of[:, ks[0]], node_of[:, k2])
+                   for k2 in ks[1:]):
+                gate_k[int(j)] = ks[0]
 
         def fix_gating(lb_a, ub_a, si, vi, val):
             k = gate_k.get(int(vi))

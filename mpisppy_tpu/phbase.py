@@ -56,6 +56,21 @@ class PHState:
 _register(PHState, tuple(f.name for f in dataclasses.fields(PHState)))
 
 
+@dataclasses.dataclass(frozen=True)
+class ScenarioView:
+    """One scenario's slice of the solution state — what denouements
+    and user callbacks receive in place of the reference's Pyomo
+    scenario instance (reference spbase.py:505-522)."""
+    index: int
+    name: str
+    x: Any         # (N,) full primal solution of this scenario
+    nonants: Any   # (K,) nonanticipative values
+    obj: float     # true objective at x
+    prob: float    # scenario probability
+    W: Any         # (K,) dual weights
+    xbar: Any      # (K,) consensus values seen by this scenario
+
+
 # ---- pure functional core (all jit-friendly) -----------------------------
 
 def compute_xbar(batch: ScenarioBatch, x_na, extra=None):
@@ -343,12 +358,33 @@ class PHBase(SPOpt):
         return self.conv
 
     def post_loops(self):
-        """Final expected objective (reference phbase.py:982)."""
+        """Final expected objective (reference phbase.py:982).
+
+        The denouement contract is the reference's
+        (rank, scenario_name, scenario): each callback receives THAT
+        scenario's data — a ScenarioView of its solution row — not the
+        global state (reference spbase.py:505-522 usage)."""
         eobj = float(self.Eobjective(self.state.obj))
         if self.scenario_denouement is not None:
             for i, name in enumerate(self.all_scenario_names):
-                self.scenario_denouement(0, name, self.state)
+                self.scenario_denouement(0, name, self.scenario_view(i))
         return eobj
+
+    def scenario_view(self, i):
+        """Per-scenario slice of the current state — the analog of the
+        reference's Pyomo scenario instance handed to denouements and
+        extensions (reference spbase.py:505-522)."""
+        st = self.state
+        return ScenarioView(
+            index=i,
+            name=self.all_scenario_names[i],
+            x=np.asarray(st.x[i]),
+            nonants=np.asarray(st.x[i, self.batch.nonant_idx]),
+            obj=float(st.obj[i]),
+            prob=float(self.batch.prob[i]),
+            W=np.asarray(st.W[i]),
+            xbar=np.asarray(st.xbar[i]),
+        )
 
     # -- bounds -----------------------------------------------------------
     def lagrangian_bound(self, W=None, certify="auto", eps=None):

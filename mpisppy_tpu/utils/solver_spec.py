@@ -57,7 +57,15 @@ def solver_specification(cfg, prefix="", name_required=False):
         and a dict of optimizer options ({"pdhg_eps": ..., ...}).
     """
     roots = list(prefix) if isinstance(prefix, (list, tuple)) else [prefix]
-    get = cfg.get if hasattr(cfg, "get") else cfg.__getitem__
+
+    def get(k):
+        """One safe accessor: .get when available, else item lookup;
+        a missing knob is None either way (never KeyError)."""
+        getter = getattr(cfg, "get", None)
+        try:
+            return getter(k) if getter is not None else cfg[k]
+        except KeyError:
+            return None
 
     def keyed(root, knob):
         return (f"solver_{knob}" if root == ""
@@ -69,7 +77,7 @@ def solver_specification(cfg, prefix="", name_required=False):
         for knob in KNOBS:
             k = keyed(sroot, knob)
             checked.append(k)
-            v = get(k) if hasattr(cfg, "get") else cfg.get(k)
+            v = get(k)
             if v is not None:
                 hits[f"pdhg_{knob}"] = v
         ostr = get(keyed(sroot, "options"))

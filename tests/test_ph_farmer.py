@@ -66,6 +66,40 @@ def test_xhat_eval_inner_bound(ph3):
     assert bad > eobj + 1000
 
 
+def test_scenario_denouement_contract():
+    """Denouements receive (rank, name, scenario) with THAT scenario's
+    data — a ScenarioView slice, not the global state (reference
+    spbase.py:505-522 contract; VERDICT r3 item 7)."""
+    b = farmer.build_batch(3)
+    seen = {}
+
+    def denouement(rank, name, scen):
+        assert rank == 0
+        assert scen.name == name
+        # per-scenario arrays, not the (S, N) global state
+        assert scen.x.ndim == 1 and scen.x.shape[0] == b.num_vars
+        assert scen.nonants.shape == (b.num_nonants,)
+        seen[name] = (scen.obj, scen.prob, scen.nonants.copy())
+
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": 50,
+            "convthresh": 1e-4, "pdhg_eps": 1e-6}
+    ph = PH(opts, [f"scen{i}" for i in range(3)], batch=b,
+            scenario_denouement=denouement)
+    ph.ph_main()
+    assert set(seen) == {"scen0", "scen1", "scen2"}
+    probs = [p for (_, p, _) in seen.values()]
+    assert abs(sum(probs) - 1.0) < 1e-9
+    # per-scenario objectives differ (different yields) and their
+    # probability-weighted sum is the expected objective
+    objs = [seen[f"scen{i}"][0] for i in range(3)]
+    eobj = float(ph.Eobjective(ph.state.obj))
+    assert abs(sum(p * o for p, o in zip(probs, objs)) - eobj) < 1e-6
+    # converged PH: every scenario's nonants agree with xbar
+    xbar = np.asarray(ph.root_xbar())
+    for _, _, na in seen.values():
+        assert np.allclose(na, xbar, atol=2.0)
+
+
 def test_ph_sharded_multi_device():
     """8 virtual CPU devices (conftest): same answer, sharded batch.
     Analog of the reference's mpiexec smoke tier (straight_tests.py)."""
