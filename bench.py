@@ -24,8 +24,20 @@ Bound validity (the round-2 failure was publishing polluted bounds):
     upper-bounds each subproblem regardless of dual convergence
     (feasibility within xhat_feastol, the FeasibilityTol analog).
 
+HANG-PROOFING (the accelerator tunnel is single-client and wedges
+transiently — observed rounds 1-3; it can wedge BETWEEN a successful
+probe and the next backend init):
+  * the top-level process never initializes jax at all;
+  * it probes the accelerator in fresh subprocesses, retrying across
+    several minutes (BENCH_PROBE_TRIES x BENCH_PROBE_WAIT);
+  * the measured run itself executes in a subprocess under a hard
+    timeout (BENCH_TPU_TIMEOUT); if that subprocess hangs or dies
+    without printing the JSON line, the bench falls back to a CPU run
+    at reduced size — so ONE json line is always produced.
+
 Prints ONE json line:
-{"metric", "value", "unit", "vs_baseline", "mfu", "iters_per_sec", ...}.
+{"metric", "value", "unit", "vs_baseline", "mfu", "iters_per_sec",
+ "certify_s", ...}.
 """
 
 import json
@@ -34,42 +46,90 @@ import subprocess
 import sys
 import time
 
-import numpy as np
+_PROBE_SRC = """
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.float32)
+y = (x @ x).block_until_ready()   # the tunnel must carry real compute
+print(d[0].platform, float(y[0, 0]))
+"""
 
 
-def _accelerator_alive(timeout_s=90):
-    """Probe the accelerator backend in a SUBPROCESS with a timeout.
-
-    The TPU plugin's device tunnel can wedge so that the first
-    jax.devices() call blocks forever (observed: a dead axon tunnel
-    hangs backend init even under JAX_PLATFORMS=cpu unless the plugin
-    is deregistered first).  A hung bench records nothing; a CPU
-    fallback records an honest number with "device": "cpu"."""
-    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
-        return False
+def _probe_once(timeout_s):
+    """Probe the accelerator in a SUBPROCESS with a timeout.  The TPU
+    plugin's device tunnel can wedge so the first jax.devices() call
+    blocks forever; a subprocess hang dies alone."""
     try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices(); "
-             "print(d[0].platform)"],
-            capture_output=True, text=True, timeout=timeout_s)
-        return r.returncode == 0 and "cpu" not in r.stdout
+        r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+        lines = r.stdout.strip().splitlines()
+        return (r.returncode == 0 and bool(lines)
+                and not lines[-1].startswith("cpu"))
     except (subprocess.TimeoutExpired, OSError):
         return False
 
 
-def main():
+def _fight_for_chip():
+    """Probe several times, spaced out: the tunnel wedges TRANSIENTLY
+    (round 2 got through; rounds 1/3 gave up after one probe).  Returns
+    (alive, attempts)."""
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        return False, 0
+    tries = int(os.environ.get("BENCH_PROBE_TRIES", 4))
+    wait = float(os.environ.get("BENCH_PROBE_WAIT", 120))
+    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
+    for attempt in range(1, tries + 1):
+        if _probe_once(timeout_s):
+            return True, attempt
+        print(f"[bench] accelerator probe {attempt}/{tries} failed",
+              file=sys.stderr)
+        if attempt < tries:
+            time.sleep(wait)
+    return False, tries
+
+
+def _run_worker(extra_env, timeout_s):
+    """Run the measured bench body in a subprocess; return its JSON
+    line (str) or None on hang/crash/no-output."""
+    env = dict(os.environ, **extra_env)
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--worker"],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        print("[bench] worker timed out", file=sys.stderr)
+        return None
+    except OSError as e:
+        print(f"[bench] worker failed to start: {e}", file=sys.stderr)
+        return None
+    sys.stderr.write(r.stderr[-4000:])
+    for ln in reversed(r.stdout.strip().splitlines()):
+        if ln.startswith("{") and ln.endswith("}"):
+            return ln
+    return None
+
+
+def worker():
+    """The measured run (executes on whatever backend the env gives)."""
+    import numpy as np
+
     from mpisppy_tpu.utils.platform import ensure_cpu_backend
-    if not _accelerator_alive():
-        ensure_cpu_backend(force=True)
-    else:
-        ensure_cpu_backend()
+    ensure_cpu_backend()
     import jax
 
     from mpisppy_tpu.models import farmer
     from mpisppy_tpu.opt.ph import PH
 
     on_tpu = jax.devices()[0].platform != "cpu"
+    if not on_tpu:
+        # the CPU protocol is f64 wherever the worker lands on CPU —
+        # including off-nominal landings where the parent didn't
+        # inject JAX_ENABLE_X64 (direct --worker runs, plugin
+        # degradation) — so device=cpu always means the f64 protocol
+        jax.config.update("jax_enable_x64", True)
     # full size on the accelerator; a smaller default on the CPU
     # fallback so a dead tunnel still yields a finished run (explicit
     # BENCH_SCENS always wins)
@@ -128,6 +188,11 @@ def main():
         "device": stats["device"],
         "scens": S,
         "crops_multiplier": mult,
+        # cost of f64 certified re-solves inside the timed region
+        # (VERDICT r3 item 2: must stay <10% of wall on the TPU path)
+        "certify_s": round(stats["certify_wall_s"], 3),
+        "certify_frac": round(stats["certify_wall_s"] / max(wall, 1e-9),
+                              4),
     }
     if fallback_sized:
         extra["note_size"] = (f"reduced size (S={S}): accelerator "
@@ -153,5 +218,34 @@ def main():
         **extra}))
 
 
+def main():
+    alive, attempts = _fight_for_chip()
+    line = None
+    if alive:
+        tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", 2700))
+        line = _run_worker({}, tpu_timeout)
+        if line is None:
+            print("[bench] accelerator run produced no result; "
+                  "falling back to CPU", file=sys.stderr)
+    if line is None:
+        cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", 5400))
+        line = _run_worker({"JAX_PLATFORMS": "cpu",
+                            "JAX_ENABLE_X64": "1"}, cpu_timeout)
+    if line is None:
+        line = json.dumps({
+            "metric": "farmer_reduced_ph_seconds_to_1pct_gap",
+            "value": -1, "unit": "s", "vs_baseline": 0,
+            "note": "no worker produced a result (hang/crash)",
+            "probe_attempts": attempts})
+    else:
+        d = json.loads(line)
+        d["probe_attempts"] = attempts
+        line = json.dumps(d)
+    print(line)
+
+
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        main()

@@ -1,0 +1,153 @@
+"""Build the documentation tree: validate + render to HTML.
+
+The image has no sphinx, so this is a dependency-free builder:
+  1. validates that every chapter listed in src/index.md exists, that
+     every relative .md link in every chapter resolves, and that every
+     repo path mentioned in prose tables exists;
+  2. renders each chapter to doc/build/<name>.html with a minimal
+     markdown converter (headers, fenced code, inline code, links,
+     tables, lists, emphasis) — enough to read in a browser.
+
+Usage:  python doc/build.py        (exit 0 = build OK)
+"""
+
+import html
+import re
+import sys
+from pathlib import Path
+
+SRC = Path(__file__).parent / "src"
+OUT = Path(__file__).parent / "build"
+REPO = Path(__file__).parent.parent
+
+_CSS = """body{max-width:48rem;margin:2rem auto;padding:0 1rem;
+font:16px/1.55 system-ui,sans-serif;color:#222}
+code{background:#f2f2f2;padding:.1em .3em;border-radius:3px;
+font-size:.92em}
+pre{background:#f6f6f6;padding: .8em;overflow-x:auto;border-radius:6px}
+pre code{background:none;padding:0}
+table{border-collapse:collapse}td,th{border:1px solid #ccc;
+padding:.3em .6em;text-align:left}
+a{color:#0b63ce}h1,h2,h3{line-height:1.25}"""
+
+
+def _inline(s):
+    s = html.escape(s, quote=False)
+    s = re.sub(r"`([^`]+)`", r"<code>\1</code>", s)
+    s = re.sub(r"\[([^\]]+)\]\(([^)]+)\)",
+               lambda m: '<a href="%s">%s</a>' % (
+                   m.group(2).replace(".md", ".html"), m.group(1)), s)
+    s = re.sub(r"\*\*([^*]+)\*\*", r"<strong>\1</strong>", s)
+    s = re.sub(r"(?<![\w*])\*([^*\n]+)\*(?![\w*])", r"<em>\1</em>", s)
+    return s
+
+
+def render(md_text, title):
+    out = ["<!doctype html><meta charset='utf-8'>",
+           f"<title>{html.escape(title)}</title>",
+           f"<style>{_CSS}</style>"]
+    lines = md_text.split("\n")
+    i, in_code, in_list, in_table = 0, False, False, False
+    while i < len(lines):
+        ln = lines[i]
+        if ln.startswith("```"):
+            if in_code:
+                out.append("</code></pre>")
+            else:
+                out.append("<pre><code>")
+            in_code = not in_code
+            i += 1
+            continue
+        if in_code:
+            out.append(html.escape(ln))
+            i += 1
+            continue
+        if in_list and not ln.lstrip().startswith(("-", "*")) \
+                and not ln.startswith("  "):
+            out.append("</ul>")
+            in_list = False
+        if in_table and not ln.startswith("|"):
+            out.append("</table>")
+            in_table = False
+        m = re.match(r"^(#{1,4})\s+(.*)", ln)
+        if m:
+            n = len(m.group(1))
+            out.append(f"<h{n}>{_inline(m.group(2))}</h{n}>")
+        elif ln.startswith("|"):
+            cells = [c.strip() for c in ln.strip("|").split("|")]
+            if all(re.fullmatch(r":?-+:?", c) for c in cells if c):
+                pass          # separator row
+            else:
+                if not in_table:
+                    out.append("<table>")
+                    in_table = True
+                    tag = "th"
+                else:
+                    tag = "td"
+                out.append("<tr>" + "".join(
+                    f"<{tag}>{_inline(c)}</{tag}>" for c in cells)
+                    + "</tr>")
+        elif ln.lstrip().startswith(("- ", "* ")):
+            if not in_list:
+                out.append("<ul>")
+                in_list = True
+            out.append(f"<li>{_inline(ln.lstrip()[2:])}</li>")
+        elif ln.strip() == "":
+            out.append("")
+        else:
+            out.append(f"<p>{_inline(ln)}</p>")
+        i += 1
+    if in_list:
+        out.append("</ul>")
+    if in_table:
+        out.append("</table>")
+    return "\n".join(out)
+
+
+def validate():
+    errors = []
+    chapters = sorted(SRC.glob("*.md"))
+    names = {p.name for p in chapters}
+    for p in chapters:
+        text = p.read_text()
+        for m in re.finditer(r"\]\(([^)#]+\.md)[^)]*\)", text):
+            tgt = m.group(1)
+            if "/" not in tgt and tgt not in names:
+                errors.append(f"{p.name}: broken link -> {tgt}")
+        # repo paths in backticks that look like files must exist
+        for m in re.finditer(
+                r"`((?:mpisppy_tpu|examples|tests|doc)/[\w/.]+?"
+                r"\.(?:py|cpp|so|md|csv))`", text):
+            if not (REPO / m.group(1)).exists():
+                errors.append(f"{p.name}: missing repo path "
+                              f"-> {m.group(1)}")
+    index = (SRC / "index.md").read_text()
+    linked = set(re.findall(r"\]\((\w+\.md)\)", index))
+    for p in chapters:
+        if p.name != "index.md" and p.name not in linked:
+            errors.append(f"index.md does not link {p.name}")
+    return errors, chapters
+
+
+def main():
+    errors, chapters = validate()
+    if errors:
+        for e in errors:
+            print("DOC ERROR:", e, file=sys.stderr)
+        return 1
+    OUT.mkdir(exist_ok=True)
+    wanted = {p.stem + ".html" for p in chapters}
+    for stale in OUT.glob("*.html"):
+        if stale.name not in wanted:
+            stale.unlink()
+    for p in chapters:
+        text = p.read_text()
+        m = re.search(r"^#\s+(.*)", text, re.M)
+        title = m.group(1) if m else p.stem
+        (OUT / (p.stem + ".html")).write_text(render(text, title))
+    print(f"doc build OK: {len(chapters)} chapters -> {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Strong-scaling protocol: PH iters/sec vs device count at fixed
+problem size — the shape of the reference's scaling study
+(reference paperruns/scripts/farmer/scaledlw.bash: 2048 scenarios,
+np = 3*{32,16,...,1}), re-cast for a device mesh: the scenario batch is
+FIXED and sharded over 1/2/4/8 mesh devices; each run times the fused
+PH superstep after compile warmup and reports iters/sec.
+
+Writes examples/scaling.csv:
+    devices,scens,scens_per_device,warm_iters,timed_iters,sec_per_iter,
+    iters_per_sec,trivial_bound
+
+Run on the 8-virtual-device CPU mesh (conftest env):
+    env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/strong_scaling.py
+On real hardware the available device counts are used (a single TPU
+chip records the 1-device row).
+
+NOTE on the virtual-CPU numbers: all virtual devices share the host's
+cores, so CPU rows measure SPMD-partitioning overhead (a flat profile
+= sharding adds no cost), not hardware speedup; speedup curves need
+real chips (BASELINE.md targets v5e-8).
+"""
+
+import csv
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def run(out_path=None):
+    from mpisppy_tpu.utils.platform import ensure_cpu_backend
+    ensure_cpu_backend()
+    import jax
+
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.opt.ph import PH
+    from mpisppy_tpu.parallel.mesh import ScenarioMesh
+
+    S = int(os.environ.get("SCALING_SCENS", 2048))
+    mult = int(os.environ.get("SCALING_MULT", 1))
+    timed = int(os.environ.get("SCALING_ITERS", 3))
+    ndev_all = len(jax.devices())
+    counts = [n for n in (1, 2, 4, 8) if n <= ndev_all]
+
+    rows = []
+    for n in counts:
+        mesh = ScenarioMesh(devices=jax.devices()[:n])
+        b = farmer.build_batch(S, crops_multiplier=mult)
+        opts = {"defaultPHrho": 1.0, "PHIterLimit": timed,
+                "convthresh": 0.0, "pdhg_eps": 1e-5,
+                "superstep_eps": 1e-4, "pdhg_max_iters": 5000}
+        ph = PH(opts, [f"scen{i}" for i in range(S)], batch=b, mesh=mesh)
+        ph.Iter0()
+        ph.ph_iteration()          # compile warmup
+        t0 = time.time()
+        for _ in range(timed):
+            ph.ph_iteration()
+        jax.block_until_ready(ph.state.x)
+        dt = (time.time() - t0) / timed
+        rows.append({
+            "devices": n, "scens": S,
+            "scens_per_device": S // n,
+            "warm_iters": 1, "timed_iters": timed,
+            "sec_per_iter": round(dt, 4),
+            "iters_per_sec": round(1.0 / dt, 4),
+            "trivial_bound": round(ph.trivial_bound, 2),
+        })
+        print(f"[scaling] {n} device(s): {dt:.3f} s/iter "
+              f"({1.0/dt:.3f} iters/s)")
+
+    out = Path(out_path or Path(__file__).parent / "scaling.csv")
+    with out.open("w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        w.writeheader()
+        w.writerows(rows)
+    print(f"[scaling] wrote {out}")
+    return rows
+
+
+if __name__ == "__main__":
+    sys.exit(0 if run() else 1)

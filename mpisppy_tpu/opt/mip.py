@@ -121,7 +121,7 @@ class ExtensiveFormMIP(ExtensiveForm):
     # are handled by the release/retry machinery.
     VIOL_TOL = 1e-3
 
-    def _feasible(self, res, tol=None):
+    def _feasible(self, res):
         return (bool(np.all(np.asarray(res.converged)))
                 and float(np.max(self._row_viol(res))) < self.VIOL_TOL)
 
@@ -143,7 +143,6 @@ class ExtensiveFormMIP(ExtensiveForm):
         ub = np.asarray(b.ub, np.float64).copy()
         dt = b.c.dtype
         S, N = lb.shape
-        tol = 10 * float(self.solver_eps)
 
         # deterministic tie-breaking perturbation on integer columns
         # (relative, so scale-free); reported objectives use the TRUE c
@@ -177,14 +176,14 @@ class ExtensiveFormMIP(ExtensiveForm):
         # principle exceed the true optimum by that epsilon
         res_true = self._lp(np.asarray(b.c, dt), lb.astype(dt),
                             ub.astype(dt))
-        if not self._feasible(res_true, tol):
+        if not self._feasible(res_true):
             raise RuntimeError("EF LP relaxation infeasible/unsolved")
         root_bound = float(np.sum(np.asarray(res_true.dual_obj)))
         # the dive itself runs on the perturbed c_s (tie-breaking);
         # warm-started from the true-c vertex this re-solve is cheap
         res = self._lp(c_s, lb.astype(dt), ub.astype(dt),
                        x0=res_true.x, y0=res_true.y)
-        if not self._feasible(res, tol):
+        if not self._feasible(res):
             res = res_true
 
         max_rounds = max_rounds or (int(np.sum(imask)) + 20)
@@ -299,7 +298,7 @@ class ExtensiveFormMIP(ExtensiveForm):
                     # bulk fixes are only kept if the re-solve stays
                     # feasible — a wrongly swallowed fractional shows
                     # up here, not at the next strong branch
-                    if not self._feasible(state["res"], tol) \
+                    if not self._feasible(state["res"]) \
                             and bulk_fixed.any() and not retried:
                         lb[bulk_fixed] = lb0[bulk_fixed]
                         ub[bulk_fixed] = ub0[bulk_fixed]
@@ -325,7 +324,7 @@ class ExtensiveFormMIP(ExtensiveForm):
                     cand = self._lp(c_s, lb2.astype(dt), ub2.astype(dt),
                                     x0=res.x, y0=res.y)
                     state["lp_solves"] += 1
-                    feas = self._feasible(cand, tol)
+                    feas = self._feasible(cand)
                     if verbose:
                         global_toc(
                             f"  branch ({si},{vi})={d:g}: feas={feas} "
@@ -391,7 +390,7 @@ class ExtensiveFormMIP(ExtensiveForm):
                 cand = self._lp(c_s, lb2.astype(dt), ub2.astype(dt),
                                 x0=state["res"].x, y0=state["res"].y)
                 state["lp_solves"] += 1
-                if not self._feasible(cand, tol):
+                if not self._feasible(cand):
                     return False
                 obj = float(np.sum(np.asarray(cand.obj)))
                 if obj >= cur - 1e-7 * (1 + abs(cur)):
@@ -520,7 +519,7 @@ class ExtensiveFormMIP(ExtensiveForm):
                 raise RuntimeError(
                     f"phase-B subproblem infeasible at scenario {bad} "
                     f"(viol={float(self._row_viol(res)[bad]):.3e}, "
-                    f"tol={tol:.1e}) with no bulk fixes to release; "
+                    f"tol={self.VIOL_TOL:.1e}) with no bulk fixes to release; "
                     f"worst row {wr}: Ax={Axb[wr]:.4f} "
                     f"lo={lo_b[wr]:.4f} hi={hi_b[wr]:.4f}")
             x = np.asarray(res.x, np.float64)
@@ -578,7 +577,7 @@ class ExtensiveFormMIP(ExtensiveForm):
                         f"v={vals[bad]:.6f} "
                         f"viol(parent)="
                         f"{float(self._row_viol(res)[bad]):.3e} "
-                        f"tol={tol:.1e}")
+                        f"tol={self.VIOL_TOL:.1e}")
                 # release the dead-ended scenarios' bulk fixes and
                 # re-derive them around the strong fixes kept so far
                 rel = release[:, None] & bulk_fixed
@@ -611,7 +610,7 @@ class ExtensiveFormMIP(ExtensiveForm):
         final = self._lp(np.asarray(b.c, dt), lb.astype(dt),
                          ub.astype(dt), x0=bx, y0=by, consensus=False)
         lp_solves += 1
-        if not self._feasible(final, tol):
+        if not self._feasible(final):
             raise RuntimeError("fixed-integer final LP infeasible")
         x = np.asarray(final.x, np.float64)
         x = np.where(imask, np.clip(np.round(x), lb, ub), x)

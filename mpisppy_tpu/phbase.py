@@ -370,20 +370,40 @@ class PHBase(SPOpt):
                 self.scenario_denouement(0, name, self.scenario_view(i))
         return eobj
 
+    def _host_state(self):
+        """Bulk device->host materialization of the solution state
+        (ONE gather per array, not one per scenario row)."""
+        st = self.state
+        return {
+            "x": np.asarray(st.x),
+            "nonants": np.asarray(st.x[:, self.batch.nonant_idx]),
+            "obj": np.asarray(st.obj),
+            "prob": np.asarray(self.batch.prob),
+            "W": np.asarray(st.W),
+            "xbar": np.asarray(st.xbar),
+        }
+
     def scenario_view(self, i):
         """Per-scenario slice of the current state — the analog of the
         reference's Pyomo scenario instance handed to denouements and
-        extensions (reference spbase.py:505-522)."""
-        st = self.state
+        extensions (reference spbase.py:505-522).  The host copy is
+        cached per iteration so S denouement calls cost one gather."""
+        h = getattr(self, "_host_cache", None)
+        if h is None or h["state"] is not self.state:
+            # keyed on state identity (PHState is frozen: every update
+            # makes a new object), so checkpoint installs and re-solves
+            # can never serve a stale view
+            h = dict(self._host_state(), state=self.state)
+            self._host_cache = h
         return ScenarioView(
             index=i,
             name=self.all_scenario_names[i],
-            x=np.asarray(st.x[i]),
-            nonants=np.asarray(st.x[i, self.batch.nonant_idx]),
-            obj=float(st.obj[i]),
-            prob=float(self.batch.prob[i]),
-            W=np.asarray(st.W[i]),
-            xbar=np.asarray(st.xbar[i]),
+            x=h["x"][i],
+            nonants=h["nonants"][i],
+            obj=float(h["obj"][i]),
+            prob=float(h["prob"][i]),
+            W=h["W"][i],
+            xbar=h["xbar"][i],
         )
 
     # -- bounds -----------------------------------------------------------

@@ -62,6 +62,7 @@ class SPOpt(SPBase):
         self._solve_times = []
         self._flops = 0.0          # accumulated kernel FLOPs (utils/mfu)
         self._solve_wall = 0.0     # accumulated timed solve seconds
+        self._certify_wall = 0.0   # seconds inside f64 certified re-solves
         # dynamic solver tolerance (Gapper schedules it) as a jnp
         # scalar — traced, so schedule changes never recompile
         self.solver_eps = jnp.asarray(self.solver.eps, self.batch.c.dtype)
@@ -180,6 +181,7 @@ class SPOpt(SPBase):
         idx = np.flatnonzero(pick & live)
         if idx.size == 0:
             return res
+        t_cert = time.time()
         b = self.batch
         A = b.A if A is None else A
         row_lo = b.row_lo if row_lo is None else row_lo
@@ -239,6 +241,7 @@ class SPOpt(SPBase):
         self._flops += _mfu.pdhg_flops(
             int(r64.iters), idx.size, b.num_rows, b.num_vars,
             self.solver.check_every)
+        self._certify_wall += time.time() - t_cert
         n_ok = int(np.sum(np.asarray(r64.converged)))
         if n_ok < idx.size:
             global_toc(f"WARNING: f64 fallback left {idx.size - n_ok} "
@@ -291,6 +294,7 @@ class SPOpt(SPBase):
         compile warmup so the reported MFU covers the timed region)."""
         self._flops = 0.0
         self._solve_wall = 0.0
+        self._certify_wall = 0.0
         self._solve_times = []
 
     def solve_stats(self):
@@ -302,6 +306,7 @@ class SPOpt(SPBase):
         return {
             "flops": self._flops,
             "solve_wall_s": self._solve_wall,
+            "certify_wall_s": self._certify_wall,
             "mfu": u,
             "device": getattr(dev, "device_kind", dev.platform),
         }

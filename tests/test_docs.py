@@ -1,0 +1,18 @@
+"""The documentation tree must stay buildable: every index link
+resolves, every referenced repo path exists (doc/build.py validate),
+and rendering produces HTML for each chapter."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent.parent
+
+
+def test_doc_build():
+    r = subprocess.run([sys.executable, str(ROOT / "doc" / "build.py")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    built = list((ROOT / "doc" / "build").glob("*.html"))
+    src = list((ROOT / "doc" / "src").glob("*.md"))
+    assert len(built) == len(src) >= 20
