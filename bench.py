@@ -112,8 +112,84 @@ def _run_worker(extra_env, timeout_s):
     return None
 
 
+def worker_uc():
+    """BENCH_MODEL=uc1000: the reference's larger_uc stretch instance —
+    1000 wind scenarios, 21-unit fleet, 24 h — PH + Lagrangian +
+    threshold-commitment recovery to a measured gap, riding the
+    shared-A matmul path (ir.bmatvec; models/uc.py shared_A).  No
+    reference wall-clock exists for this instance, so vs_baseline is 0;
+    the JSON records gap, wall, MFU."""
+    import numpy as np
+
+    from mpisppy_tpu.utils.platform import ensure_cpu_backend
+    ensure_cpu_backend()
+    import jax
+
+    from mpisppy_tpu.models import uc
+    from mpisppy_tpu.opt.ph import PH
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if not on_tpu:
+        jax.config.update("jax_enable_x64", True)
+    S = int(os.environ.get("BENCH_SCENS", 1000))
+    fm = int(os.environ.get("BENCH_UC_FLEET", 7 if on_tpu else 2))
+    H = int(os.environ.get("BENCH_UC_HOURS", 24 if on_tpu else 6))
+    iters = int(os.environ.get("BENCH_UC_ITERS", 10))
+
+    b = uc.build_batch(S, H=H, fleet_multiplier=fm,
+                       dtype=np.float32 if on_tpu else np.float64)
+    ph = PH({"defaultPHrho": 50.0, "PHIterLimit": iters,
+             "convthresh": 0.0, "pdhg_eps": 1e-5,
+             "superstep_eps": 1e-4, "lagrangian_eps": 1e-4,
+             "pdhg_max_iters": 20000},
+            [f"s{i}" for i in range(S)], batch=b)
+    ph.Iter0()         # compile warmup
+    ph.ph_iteration()
+    ph.clear_warmstart()
+    ph.reset_solve_stats()
+    t0 = time.time()
+    ph.Iter0()
+    outer = ph.trivial_bound
+    for _ in range(iters):
+        ph.ph_iteration()
+    outer = max(outer, ph.lagrangian_bound())
+    xbar = np.asarray(ph.state.xbar)[0]
+    cands = uc.commitment_candidates(b, xbar)
+    objs, feas = ph.evaluate_candidates(cands)
+    ok = np.flatnonzero(feas)
+    inner, cfeas = (np.inf, False)
+    if ok.size:
+        inner, cfeas = ph.evaluate_xhat(
+            cands[int(ok[np.argmin(objs[ok])])])
+    jax.block_until_ready(ph.state.x)
+    wall = time.time() - t0
+    stats = ph.solve_stats()
+    if not cfeas:
+        # an infeasible recovery must not report a gap/incumbent
+        print(json.dumps({
+            "metric": f"uc{S}_ph_seconds_to_recovered_commitment",
+            "value": -1, "unit": "s", "vs_baseline": 0,
+            "note": "no feasible commitment candidate",
+            "device": stats["device"], "scens": S}))
+        return
+    gap = (inner - outer) / max(abs(inner), 1e-9)
+    print(json.dumps({
+        "metric": f"uc{S}_ph_seconds_to_recovered_commitment",
+        "value": round(wall, 3), "unit": "s", "vs_baseline": 0,
+        "gap": round(float(gap), 5), "inner": round(float(inner), 2),
+        "outer": round(float(outer), 2),
+        "mfu": (round(stats["mfu"], 6) if stats["mfu"] is not None
+                else None),
+        "kernel_tflops": round(stats["flops"] / 1e12, 3),
+        "device": stats["device"], "scens": S, "units": 3 * fm,
+        "hours": H, "certify_s": round(stats["certify_wall_s"], 3),
+        "shared_A": bool(b.shared_A)}))
+
+
 def worker():
     """The measured run (executes on whatever backend the env gives)."""
+    if os.environ.get("BENCH_MODEL", "farmer") == "uc1000":
+        return worker_uc()
     import numpy as np
 
     from mpisppy_tpu.utils.platform import ensure_cpu_backend

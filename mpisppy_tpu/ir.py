@@ -111,6 +111,22 @@ class ScenarioBatch:
         return self.c.shape[0]
 
     @property
+    def shared_A(self):
+        """True when ONE constraint matrix serves every scenario (the
+        uncertainty lives in row bounds / objective only): A is stored
+        (1, M, N) and ops use ir.bmatvec's matmul fast path."""
+        return self.A.shape[0] == 1 and self.c.shape[0] > 1
+
+    def densify(self):
+        """Materialize a per-scenario A from a shared one (for code
+        paths that index A by scenario, e.g. the MIP dive)."""
+        if not self.shared_A:
+            return self
+        A = jnp.broadcast_to(self.A[0][None],
+                             (self.num_scens,) + self.A.shape[1:])
+        return dataclasses.replace(self, A=A)
+
+    @property
     def num_vars(self):
         return self.c.shape[1]
 
@@ -147,6 +163,27 @@ _register(
     ),
     meta_fields=("var_names",),
 )
+
+
+def bmatvec(A, x):
+    """Batched A @ x: A (SA, M, N) with SA == S or SA == 1 (shared
+    constraint matrix), x (S, N) -> (S, M).
+
+    The shared-A case is the TPU-native fast path for model families
+    whose uncertainty lives in the ROW BOUNDS only (UC wind, many
+    two-stage demand models): one (M, N) matrix turns the batched
+    matvec into a real (S, N) x (N, M) matmul on the MXU and cuts the
+    constraint-tensor memory by S."""
+    if A.shape[0] == 1:
+        return x @ A[0].T
+    return jnp.einsum("smn,sn->sm", A, x)
+
+
+def bmatvec_t(A, y):
+    """Batched A^T @ y: A (SA, M, N), y (S, M) -> (S, N)."""
+    if A.shape[0] == 1:
+        return y @ A[0]
+    return jnp.einsum("smn,sm->sn", A, y)
 
 
 def node_segment_sum(node_of, num_nodes):
@@ -273,10 +310,12 @@ def pad_scenarios(batch: ScenarioBatch, to: int) -> ScenarioBatch:
             f"_pad{i}" for i in range(padn)),
     )
     # Dummy scenarios: feasible-by-construction (free rows, unit box).
+    # A shared constraint matrix needs no padding — pads reuse it under
+    # free row bounds (any box point satisfies free rows).
     return ScenarioBatch(
         c=padfield(batch.c),
         qdiag=padfield(batch.qdiag),
-        A=padfield(batch.A),
+        A=batch.A if batch.shared_A else padfield(batch.A),
         row_lo=padfield(batch.row_lo, -np.inf),
         row_hi=padfield(batch.row_hi, np.inf),
         lb=padfield(batch.lb),

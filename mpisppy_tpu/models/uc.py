@@ -50,11 +50,19 @@ def wind_profile(scennum, H, seed=91):
 
 
 def build_batch(num_scens, H=6, n_units=None, seed=91,
-                fleet_multiplier=1, dtype=np.float64):
+                fleet_multiplier=1, dtype=np.float64, shared_A=True):
     """fleet_multiplier k replicates the 3-unit fleet k times with
     seeded parameter jitter and scales demand to match — the scaling
     axis of the reference's larger_uc instances (paperruns/larger_uc:
-    3..1000 wind scenarios on bigger systems)."""
+    3..1000 wind scenarios on bigger systems).
+
+    shared_A (default True): UC's uncertainty lives entirely in the
+    balance-row BOUNDS (wind offsets demand) — the constraint matrix is
+    scenario-independent.  Storing it once, (1, M, N), turns every
+    batched matvec into a real (S, N) x (N, M) matmul on the MXU
+    (ir.bmatvec) and cuts the constraint-tensor memory by S, which is
+    what makes the 1000-wind-scenario, 20+-unit, 24 h instances of the
+    reference's larger_uc study fit on one chip."""
     fleet = _FLEET if n_units is None else _FLEET[:n_units]
     if fleet_multiplier > 1:
         rng = np.random.RandomState(seed + 5)
@@ -83,7 +91,8 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
     # rows: pmax (GH), pmin (GH), balance (H), startup (GH),
     # ramp up (G(H-1)), ramp down (G(H-1))
     M = 3 * G * H + H + 2 * G * (H - 1)
-    A = np.zeros((S, M, N), dtype=dtype)
+    SA = 1 if shared_A else S   # matrix is scenario-independent
+    A = np.zeros((SA, M, N), dtype=dtype)
     row_lo = np.full((S, M), -INF, dtype=dtype)
     row_hi = np.full((S, M), INF, dtype=dtype)
     r = 0

@@ -42,6 +42,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# one shared-A broadcast dispatch rule for the whole package: the
+# SA == 1 fast path turns the batched matvec into a real matmul
+from ..ir import bmatvec as _Ax
+from ..ir import bmatvec_t as _ATy
+
 
 def _register(cls, data_fields, meta_fields=()):
     jax.tree_util.register_dataclass(
@@ -80,9 +85,17 @@ class ConsensusSpec:
     node_of: Any      # (S, K) node id per scenario per nonant slot
     nonant_idx: Any   # (K,) column indices of nonant slots
     num_nodes: int = 1
+    # number of INDEPENDENT stacked EF copies along the scenario axis
+    # (opt/mip._lp_multi probes k bound-variants in one launch): every
+    # batch-global reduction — power-iteration norm, step sizes, the
+    # one-problem KKT verdict, the restart omega — is taken PER COPY,
+    # so a degenerate/infeasible variant cannot pollute its siblings'
+    # step sizes or convergence verdicts
+    num_copies: int = 1
 
 
-_register(ConsensusSpec, ("node_of", "nonant_idx"), ("num_nodes",))
+_register(ConsensusSpec, ("node_of", "nonant_idx"),
+          ("num_nodes", "num_copies"))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,12 +157,12 @@ def _power_iteration(A, iters=40, seed=0):
 
     def body(_, v):
         v = v / (jnp.linalg.norm(v, axis=1, keepdims=True) + 1e-30)
-        av = jnp.einsum("smn,sn->sm", A, v)
-        v = jnp.einsum("smn,sm->sn", A, av)
+        av = _Ax(A, v)
+        v = _ATy(A, av)
         return v
 
     v = lax.fori_loop(0, iters, body, v)
-    av = jnp.einsum("smn,sn->sm", A, v / (
+    av = _Ax(A, v / (
         jnp.linalg.norm(v, axis=1, keepdims=True) + 1e-30))
     return jnp.linalg.norm(av, axis=1)
 
@@ -176,6 +189,7 @@ def prepare_batch(A, row_lo, row_hi, ruiz_iters=10, shared_cols=False):
 # core iteration pieces (all batched over leading S axis)
 # --------------------------------------------------------------------------
 
+
 def _proj_box(x, lb, ub):
     return jnp.clip(x, lb, ub)
 
@@ -199,7 +213,7 @@ def _residuals(x, y, c, qdiag, A, row_lo, row_hi, lb, ub, cavg=None):
     reduced cost by (segment sum / member count) so per-scenario sums of
     rc terms equal the shared-variable (EF) dual-objective terms.
     """
-    Ax = jnp.einsum("smn,sn->sm", A, x)
+    Ax = _Ax(A, x)
     # primal violation of row bounds (box is enforced by projection)
     pviol = jnp.maximum(jnp.maximum(row_lo - Ax, Ax - row_hi), 0.0)
     pviol = jnp.where(jnp.isfinite(pviol), pviol, 0.0)
@@ -210,7 +224,7 @@ def _residuals(x, y, c, qdiag, A, row_lo, row_hi, lb, ub, cavg=None):
 
     # dual: r = grad f + A^T y ; must live in normal cone of the box
     grad = c + qdiag * x
-    aty = jnp.einsum("smn,sm->sn", A, y)
+    aty = _ATy(A, y)
     r = grad + aty
     if cavg is not None:
         r = cavg(r)
@@ -368,23 +382,42 @@ class PDHGSolver:
             # z-space norm weights: shared coords counted once
             wz = jnp.ones_like(cs).at[:, na].set(1.0 / counts)
 
+            # per-copy reductions over the scenario axis: with
+            # num_copies stacked independent EFs (opt/mip._lp_multi),
+            # each copy is its own problem and must get its own norm /
+            # step size / verdict (nc == 1 degenerates to the plain
+            # batch-global reductions)
+            nc = max(int(getattr(consensus, "num_copies", 1) or 1), 1)
+            S0 = S // nc
+
+            def scen_sum(a):
+                """(S,) -> per-copy sum, broadcast back to (S,)."""
+                return jnp.repeat(
+                    jnp.sum(a.reshape(nc, S0), axis=1), S0)
+
+            def scen_max(a):
+                return jnp.repeat(
+                    jnp.max(a.reshape(nc, S0), axis=1), S0)
+
             def znorm(g):
-                return jnp.sqrt(jnp.sum(wz * g * g)) + 1e-30
+                """(S, ...) -> per-copy z-norm, (S,) broadcast."""
+                return jnp.sqrt(scen_sum(
+                    jnp.sum(wz * g * g, axis=1))) + 1e-30
 
             # power iteration for the EF operator  M = blockdiag(A) . B
             key = jax.random.PRNGKey(0)
             v = cavg(jax.random.normal(key, (S, N), cs.dtype))
 
             def pbody(_, v):
-                v = v / znorm(v)
-                u = jnp.einsum("smn,sn->sm", A, v)
-                return csum(jnp.einsum("smn,sm->sn", A, u))
+                v = v / znorm(v)[:, None]
+                u = _Ax(A, v)
+                return csum(_ATy(A, u))
 
             v = lax.fori_loop(0, 40, pbody, v)
-            anorm_c = jnp.sqrt(jnp.sum(
-                jnp.einsum("smn,sn->sm", A, v / znorm(v)) ** 2))
-            anorm = jnp.full((S,), jnp.maximum(anorm_c, 1.0), cs.dtype)
-            qmax = jnp.full((S,), jnp.max(csum(qs)), cs.dtype)
+            av = _Ax(A, v / znorm(v)[:, None])
+            anorm_c = jnp.sqrt(scen_sum(jnp.sum(av * av, axis=1)))
+            anorm = jnp.maximum(anorm_c, 1.0).astype(cs.dtype)
+            qmax = scen_max(jnp.max(csum(qs), axis=1)).astype(cs.dtype)
             xs0 = jnp.clip(cavg(xs0), lbs, ubs)  # consistent warm start
         else:
             csum = cavg = None
@@ -396,7 +429,10 @@ class PDHGSolver:
             sigma = 0.9 * omega / anorm
             tau = 0.9 / (omega * anorm + 0.9 * qmax)
 
-            if self.use_pallas and csum is None:
+            if self.use_pallas and csum is None \
+                    and A.shape[0] == x.shape[0]:
+                # (the Pallas chunk kernel tiles per-scenario A slabs;
+                # shared-A batches use the XLA matmul path)
                 from .pallas_pdhg import fused_chunk
                 return fused_chunk(
                     A, cs, qs, lbs, ubs, rlo, rhi, x, y,
@@ -405,12 +441,12 @@ class PDHGSolver:
 
             def body(_, carry):
                 x, y, xs, ys = carry
-                grad = cs + qs * x + jnp.einsum("smn,sm->sn", A, y)
+                grad = cs + qs * x + _ATy(A, y)
                 if csum is not None:
                     grad = csum(grad)
                 xn = _proj_box(x - tau[:, None] * grad, lbs, ubs)
                 xt = 2.0 * xn - x
-                v = y + sigma[:, None] * jnp.einsum("smn,sn->sm", A, xt)
+                v = y + sigma[:, None] * _Ax(A, xt)
                 yn = _dual_prox(v, sigma, rlo, rhi)
                 return xn, yn, xs + xn, ys + yn
 
@@ -423,15 +459,14 @@ class PDHGSolver:
             pres, dres, gap, pobj, dobj = _residuals(
                 x, y, cs, qs, A, rlo, rhi, lbs, ubs, cavg=cavg)
             if consensus is not None:
-                # EF is one problem: all scenarios share one verdict,
-                # and only the SUMS of the per-scenario objective pieces
-                # are meaningful for the duality gap
-                pres = jnp.broadcast_to(jnp.max(pres), pres.shape)
-                dres = jnp.broadcast_to(jnp.max(dres), dres.shape)
-                ps, ds = jnp.sum(pobj), jnp.sum(dobj)
-                gap = jnp.broadcast_to(
-                    jnp.abs(ps - ds) / (1.0 + jnp.abs(ps) + jnp.abs(ds)),
-                    gap.shape)
+                # each EF COPY is one problem: its scenarios share one
+                # verdict, and only the SUMS of its per-scenario
+                # objective pieces are meaningful for the duality gap
+                pres = scen_max(pres)
+                dres = scen_max(dres)
+                ps, ds = scen_sum(pobj), scen_sum(dobj)
+                gap = jnp.abs(ps - ds) / (1.0 + jnp.abs(ps)
+                                          + jnp.abs(ds))
             return pres + dres + gap, pres, dres, gap
 
         ne = self.check_every
@@ -465,13 +500,14 @@ class PDHGSolver:
                 yr = jnp.where(take_avg[:, None], ya, y)
                 # primal weight update (PDLP eq. (10)-style smoothing)
                 if consensus is not None:
-                    # one shared problem -> one shared omega (per-scenario
-                    # omegas would give inconsistent step sizes and break
-                    # the shared-variable invariant)
-                    dx = jnp.broadcast_to(
-                        jnp.linalg.norm(xr - carry.x_last), (S,))
-                    dy = jnp.broadcast_to(
-                        jnp.linalg.norm(yr - carry.y_last), (S,))
+                    # one shared problem PER COPY -> one shared omega
+                    # per copy (per-scenario omegas would give
+                    # inconsistent step sizes and break the
+                    # shared-variable invariant)
+                    dxv = xr - carry.x_last
+                    dyv = yr - carry.y_last
+                    dx = jnp.sqrt(scen_sum(jnp.sum(dxv * dxv, axis=1)))
+                    dy = jnp.sqrt(scen_sum(jnp.sum(dyv * dyv, axis=1)))
                 else:
                     dx = jnp.linalg.norm(xr - carry.x_last, axis=1)
                     dy = jnp.linalg.norm(yr - carry.y_last, axis=1)

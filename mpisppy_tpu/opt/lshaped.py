@@ -40,6 +40,7 @@ from ..spopt import SPOpt
 
 
 class LShapedMethod(SPOpt):
+    _needs_dense_A = True   # cut generation indexes A by scenario
     def __init__(self, options, all_scenario_names, **kwargs):
         super().__init__(options, all_scenario_names, **kwargs)
         if self.batch.tree.num_nodes > 2:  # ROOT (+ possibly pad node)

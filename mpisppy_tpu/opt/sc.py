@@ -45,6 +45,7 @@ BIG = 1e8
 
 
 class SchurComplement(SPBase):
+    _needs_dense_A = True   # KKT assembly indexes A by scenario
     def __init__(self, options, all_scenario_names, **kwargs):
         super().__init__(options, all_scenario_names, **kwargs)
         if bool(np.asarray(self.batch.integer_mask).any()):

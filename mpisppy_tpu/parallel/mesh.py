@@ -71,6 +71,11 @@ class ScenarioMesh:
                 return None
             arr = jax.numpy.asarray(leaf)
             name = path[-1].name if hasattr(path[-1], "name") else None
+            if name == "A" and arr.shape[0] == 1:
+                # shared constraint matrix (ir.ScenarioBatch.shared_A):
+                # replicated, not sharded — every device multiplies its
+                # scenario shard against the same (M, N) matrix
+                return jax.device_put(arr, repl)
             if name in scen_leading:
                 return jax.device_put(arr, shard)
             if name == "stage_cost_c":  # (n_stages, S, N)

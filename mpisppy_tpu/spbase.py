@@ -24,6 +24,12 @@ from .parallel.mesh import ScenarioMesh
 
 
 class SPBase:
+    # algorithms that index A by scenario (MIP dive, L-shaped cuts,
+    # Schur-complement assembly) set this; SPBase then materializes the
+    # per-scenario view of a shared-A batch (ir.ScenarioBatch.densify)
+    # once at construction instead of each subclass repeating the guard
+    _needs_dense_A = False
+
     def __init__(
         self,
         options,
@@ -53,6 +59,8 @@ class SPBase:
                 for name in self.all_scenario_names
             ]
             batch = stack_scenarios(scens, scen_names=self.all_scenario_names)
+        if self._needs_dense_A and batch.shared_A:
+            batch = batch.densify()
         self.n_real_scens = len(self.all_scenario_names)
         if variable_probability is not None:
             # per-(scenario, nonant-slot) averaging weights (reference

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import global_toc
+from .ir import bmatvec
 from .ops.pdhg import PDHGSolver, prepare_batch
 from .spbase import SPBase
 from .utils import mfu as _mfu
@@ -221,10 +222,19 @@ class SPOpt(SPBase):
                     shared_cols=self._shared_cols)
                 full = jax.tree.map(np.asarray, full)
                 self._np_cache[prep_key] = full
-            prep64 = jax.tree.map(lambda a: put(a[idx]), full)
+
+            S_all = self.batch.num_scens
+
+            def take(a):
+                # shared-A batches keep singleton leaves (A, d_row,
+                # d_col, anorm stay (1, ...)); per-scenario leaves are
+                # gathered to the straggler sub-batch
+                return a if (a.shape[0] == 1 and S_all > 1) else a[idx]
+
+            prep64 = jax.tree.map(lambda a: put(take(a)), full)
             # row bounds may be call-specific (xhat candidates shift
             # them); rebuild the scaled fields from the raw bounds
-            dr = np.asarray(full.d_row)[idx]
+            dr = np.asarray(take(np.asarray(full.d_row)))
             prep64 = dataclasses.replace(
                 prep64,
                 row_lo=put(np.where(np.isfinite(sub["row_lo"]),
@@ -458,7 +468,7 @@ class SPOpt(SPBase):
             vals2 = jnp.broadcast_to(
                 jnp.atleast_2d(vals), (b.num_scens, na.size)
             ).astype(b.c.dtype)
-            shift = jnp.einsum("smk,sk->sm", A_na, vals2)
+            shift = bmatvec(A_na, vals2)
             prep2, rlo, rhi = self._shift_and_widen_rows(
                 prep, b.row_lo, b.row_hi, shift, ftol)
             oc = (b.obj_const + jnp.sum(c_na * vals2, axis=1)
@@ -545,7 +555,14 @@ class SPOpt(SPBase):
             del self._np_cache[stale]
         stack = self._np_cache.get(tkey)
         if stack is None:
-            tile = lambda a: jnp.tile(a, (k,) + (1,) * (a.ndim - 1))  # noqa: E731
+            S_all = b.num_scens
+
+            def tile(a):
+                # shared-A leaves (shape (1, ...)) serve every stacked
+                # candidate as-is; per-scenario leaves tile k-fold
+                if a.shape[0] == 1 and S_all > 1:
+                    return a
+                return jnp.tile(a, (k,) + (1,) * (a.ndim - 1))
             prep = cache["prep"]
             nai = jnp.asarray(cache["na"], jnp.int32)
             stack = {
@@ -568,7 +585,7 @@ class SPOpt(SPBase):
                 # vals_ks: (k, K) -> (k*S, K)
                 vals2 = jnp.repeat(vals_ks, b.num_scens, axis=0).astype(
                     b.c.dtype)
-                shift = jnp.einsum("smk,sk->sm", stack["A_na"], vals2)
+                shift = bmatvec(stack["A_na"], vals2)
                 prep2, rlo, rhi = self._shift_and_widen_rows(
                     stack["prep"], stack["row_lo"], stack["row_hi"],
                     shift, ftol)

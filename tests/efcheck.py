@@ -19,7 +19,9 @@ def ef_linprog(batch, n_real=None):
     """Returns (optimal value, per-scenario x (S, N)) of the EF LP
     relaxation.  Uses only the first n_real scenarios (drop padding)."""
     A = np.asarray(batch.A)
-    S = A.shape[0] if n_real is None else n_real
+    S = batch.num_scens if n_real is None else n_real
+    if A.shape[0] == 1 and S > 1:     # shared-A batch (ir.shared_A)
+        A = np.broadcast_to(A[0], (S,) + A.shape[1:])
     A = A[:S]
     N = A.shape[2]
     Mr = A.shape[1]
@@ -88,7 +90,9 @@ def ef_milp(batch, n_real=None, mip_rel_gap=1e-6, time_limit=None):
     pin the reference's integer goldens (e.g. sizes-3 EF == 220000 at
     2 sig figs, reference test_ef_ph.py:137)."""
     A = np.asarray(batch.A)
-    S = A.shape[0] if n_real is None else n_real
+    S = batch.num_scens if n_real is None else n_real
+    if A.shape[0] == 1 and S > 1:     # shared-A batch (ir.shared_A)
+        A = np.broadcast_to(A[0], (S,) + A.shape[1:])
     A = A[:S]
     N = A.shape[2]
     Mr = A.shape[1]
