@@ -129,7 +129,11 @@ class ExtensiveFormMIP(ExtensiveForm):
                 max_iters=max_iters,
                 eps=self.solver.eps,
                 check_every=self.solver.check_every,
-                restart_every=self.solver.restart_every)
+                restart_every=self.solver.restart_every,
+                use_pallas=self.solver.use_pallas,
+                pallas_tile=self.solver.pallas_tile,
+                pallas_interpret=self.solver.pallas_interpret,
+                omega0=self.solver.omega0)
             self._np_cache[key] = s
         return s
 
@@ -153,7 +157,7 @@ class ExtensiveFormMIP(ExtensiveForm):
         if k == 1:
             return [self._lp(c_s, bounds[0][0], bounds[0][1], x0=x0,
                              y0=y0, consensus=consensus, eps=eps,
-                             certify=False)]
+                             certify=False, max_iters=max_iters)]
         b = self.batch
         S = b.num_scens
         dt = b.c.dtype

@@ -105,3 +105,71 @@ def test_acopf3_ph_multistage_runs():
     conv, eobj, triv = ph.ph_main()
     assert np.isfinite(eobj) and np.isfinite(triv)
     assert triv <= eobj + 1e-3 * abs(eobj)
+
+
+# ---- aircond reference-parameter parity (round 4 deepening) ----------
+
+def test_aircond_reference_parameters():
+    """The reference parms table (aircond.py:15-34) is fully plumbed:
+    salvage terminal inventory cost, quadratic shortage, random-walk
+    demand clipping, parameter overrides."""
+    from mpisppy_tpu.models import aircond
+    b = aircond.build_batch(branching_factors=(2, 2))
+    T = 3
+    c = np.asarray(b.c)
+    # terminal posInventory carries the NEGATIVE salvage coefficient
+    ii_last = 4 * (T - 1) + 2
+    assert np.allclose(c[:, ii_last], aircond.PARMS["LastInventoryCost"])
+    assert c[0, ii_last] < 0
+    # non-terminal stages carry the holding cost
+    assert np.allclose(c[:, 2], aircond.PARMS["InventoryCost"])
+    # random-walk demand honors [min_d, max_d]
+    lo = np.asarray(b.row_lo)
+    d_implied = -(lo[:, 1])                 # stage-2 balance rhs
+    assert np.all(d_implied >= aircond.PARMS["min_d"] - 1e-9)
+    assert np.all(d_implied <= aircond.PARMS["max_d"] + 1e-9)
+    # QuadShortCoeff becomes native qdiag on the shortage columns
+    b2 = aircond.build_batch(branching_factors=(2, 2),
+                             QuadShortCoeff=0.3)
+    q = np.asarray(b2.qdiag)
+    assert np.allclose(q[:, 3], 0.6)        # 0.5*qdiag*x^2 convention
+    assert np.allclose(q[:, 4 * (T - 1) + 3], 0.0)   # not at last stage
+    # parameter override reaches the objective
+    b3 = aircond.build_batch(branching_factors=(2, 2),
+                             OvertimeProdCost=7.0)
+    assert np.allclose(np.asarray(b3.c)[:, 1], 7.0)
+    with pytest.raises(ValueError):
+        aircond.build_batch(branching_factors=(2,), NoSuchParam=1)
+
+
+def test_aircond_start_ups_integer_variant():
+    """start_ups=True adds per-stage binaries with big-M forcing rows
+    (reference aircond.py:142-144): producing anything requires the
+    stage's StartUp to be on, and the MIP dive prices it."""
+    from mpisppy_tpu.models import aircond
+    from mpisppy_tpu.opt.mip import ExtensiveFormMIP
+    b = aircond.build_batch(branching_factors=(2,), start_ups=True,
+                            sigma_dev=20.0)
+    assert bool(np.any(np.asarray(b.integer_mask)))
+    T = 2
+    assert b.num_vars == 4 * T + T
+    assert b.num_nonants == 5 * (T - 1)
+    ef = ExtensiveFormMIP({"pdhg_eps": 1e-6, "pdhg_max_iters": 100000},
+                          list(b.tree.scen_names), batch=b)
+    out = ef.solve_mip()
+    live = np.asarray(ef.batch.prob) > 0      # out includes pad rows
+    u = out["x"][live][:, 4 * T:]
+    assert np.allclose(u, np.round(u))
+    # demand is positive in every scenario, so something must produce:
+    # at least one stage's start-up is on, and its cost is real
+    assert np.all(u.sum(axis=1) >= 1 - 1e-9)
+    assert out["bound"] <= out["incumbent"] + 1e-6
+
+
+def test_aircond_xhat_generator():
+    from mpisppy_tpu.models import aircond
+    xh = aircond.xhat_generator_aircond(
+        ["Scenario1", "Scenario2"], branching_factors=[2],
+        start_seed=7)
+    assert xh.shape == (4,)                 # stage-1 nonants
+    assert np.all(np.isfinite(xh))
