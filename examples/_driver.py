@@ -50,7 +50,12 @@ def standard_cfg():
 def cylinders_main(module, progname, args=None, extraargs_fct=None):
     """Parse the standard flag surface and run the model through the
     Amalgamator.  Returns the Amalgamator (bounds on
-    .best_inner_bound/.best_outer_bound, or .EF_Obj in --EF mode)."""
+    .best_inner_bound/.best_outer_bound, or .EF_Obj in --EF mode).
+
+    Prints one machine-readable `DRIVER_WALL build=..s run=..s` line —
+    run_all.py records the split so corpus timings separate problem
+    construction from the solve loop (whose first iteration carries
+    the jit compiles)."""
     cfg = standard_cfg()
     if extraargs_fct is not None:
         extraargs_fct(cfg)
@@ -62,4 +67,6 @@ def cylinders_main(module, progname, args=None, extraargs_fct=None):
     else:
         print(f"BestInnerBound = {ama.best_inner_bound}")
         print(f"BestOuterBound = {ama.best_outer_bound}")
+    print(f"DRIVER_WALL build={ama.wall_build:.2f}s "
+          f"run={ama.wall_run:.2f}s")
     return ama

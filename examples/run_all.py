@@ -9,6 +9,8 @@ the runner shells out plain `python <driver> <args>` lines.
 
     python examples/run_all.py            # full corpus (CPU backend)
     python examples/run_all.py --fast     # afew-style quick subset
+    python examples/run_all.py --medium   # + non-toy rows (padding/
+                                          #   sharding at scale)
     python examples/run_all.py --tpu      # keep the ambient platform
 """
 
@@ -69,10 +71,44 @@ CORPUS = [
 FAST = {"farmer_cylinders.py", "farmer_lshapedhub.py",
         "sizes_cylinders.py"}    # the reference's afew.py subset
 
+# --medium: a non-toy tier that exercises padding/sharding at scale
+# (VERDICT r3: the corpus never left --num-scens 3..10); sizes chosen
+# to finish in minutes each on the 1-core CPU smoke box
+MEDIUM = [
+    ("farmer_cylinders.py",
+     "--num-scens 256 --crops-multiplier 4 --max-iterations 10 "
+     "--default-rho 1 --lagrangian --xhatshuffle"),
+    ("sslp_cylinders.py",
+     "--num-scens 50 --max-iterations 10 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+    ("uc_cylinders.py",
+     "--num-scens 100 --max-iterations 5 --default-rho 50 "
+     "--lagrangian --xhatshuffle"),
+    # (hydro's published branch data caps its tree at 3 children per
+    # node, so the multistage medium row is aircond's sampled tree)
+    ("aircond_cylinders.py",
+     "--branching-factors 4,3,2 --max-iterations 10 --default-rho 1 "
+     "--lagrangian --xhatshuffle"),
+]
+
+
+def _wall_split(stdout):
+    """Parse the drivers' `DRIVER_WALL build=..s run=..s` line."""
+    for ln in reversed(stdout.splitlines()):
+        if ln.startswith("DRIVER_WALL"):
+            try:
+                parts = dict(tok.split("=") for tok in ln.split()[1:])
+                return (float(parts["build"].rstrip("s")),
+                        float(parts["run"].rstrip("s")))
+            except (ValueError, KeyError):
+                return None, None
+    return None, None
+
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     fast = "--fast" in argv
+    medium = "--medium" in argv
     rows = []
     badguys = []
     env = dict(os.environ)
@@ -83,7 +119,8 @@ def main(argv=None):
         env["JAX_PLATFORMS"] = "cpu"
     root = os.path.dirname(HERE)
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
-    for prog, argstring in CORPUS:
+    corpus = list(CORPUS) + (MEDIUM if medium else [])
+    for prog, argstring in corpus:
         if fast and prog not in FAST:
             continue
         cmd = [sys.executable, os.path.join(HERE, prog)] + argstring.split()
@@ -93,8 +130,10 @@ def main(argv=None):
                            capture_output=True, text=True)
         dt = time.time() - t0
         ok = r.returncode == 0
+        build_s, run_s = _wall_split(r.stdout)
         rows.append({"program": prog, "args": argstring,
-                     "seconds": round(dt, 2), "ok": ok})
+                     "seconds": round(dt, 2),
+                     "build_s": build_s, "run_s": run_s, "ok": ok})
         if not ok:
             badguys.append((prog, r.returncode))
             print(r.stdout[-2000:])
@@ -105,7 +144,7 @@ def main(argv=None):
     csv_path = os.path.join(HERE, "run_all_timings.csv")
     with open(csv_path, "w", newline="") as f:
         w = csv.DictWriter(f, fieldnames=["program", "args", "seconds",
-                                          "ok"])
+                                          "build_s", "run_s", "ok"])
         w.writeheader()
         w.writerows(rows)
     print(f"timings written to {csv_path}")

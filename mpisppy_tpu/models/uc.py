@@ -11,9 +11,13 @@ p_gh >= 0 and load shed sh_h >= 0:
     sum_g p_gh + wind^s_h + sh_h >= demand_h        (balance)
     s_gh >= u_gh - u_g,h-1                          (startup def)
     |p_gh - p_g,h-1| <= ramp_g                      (ramping)
+    u_g,tau >= u_gh - u_g,h-1   for tau in (h, h+UT_g)   (min up)
+    u_g,tau <= 1 - (u_g,h-1 - u_gh) for tau in (h, h+DT_g)  (min down)
     min sum_gh (cNL_g u_gh + cSU_g s_gh) +
         E[ sum_gh cV_g p_gh + pen * sum_h sh_h ]
-Nonants: u, s (first stage).
+Nonants: u, s (first stage).  Min-up/min-down times (UT/DT per unit,
+the reference egret UC's uptime/downtime constraints) activate with
+min_up_down=True — big units carry the longer windows.
 
 Unit data is a fixed small fleet; wind is a seeded hourly profile per
 scenario (the reference's 3..1000 wind-scenario instances).
@@ -34,6 +38,9 @@ _FLEET = np.array([
     [50.0, 200.0, 100.0, 300.0, 400.0, 25.0],     # mid gas
     [10.0, 100.0, 100.0, 100.0, 100.0, 40.0],     # peaker
 ])
+# min-up / min-down hours per base unit (big units cycle slowly)
+_UT = np.array([3, 2, 1])
+_DT = np.array([3, 2, 1])
 _PEN = 1000.0
 
 
@@ -50,7 +57,8 @@ def wind_profile(scennum, H, seed=91):
 
 
 def build_batch(num_scens, H=6, n_units=None, seed=91,
-                fleet_multiplier=1, dtype=np.float64, shared_A=True):
+                fleet_multiplier=1, dtype=np.float64, shared_A=True,
+                min_up_down=False):
     """fleet_multiplier k replicates the 3-unit fleet k times with
     seeded parameter jitter and scales demand to match — the scaling
     axis of the reference's larger_uc instances (paperruns/larger_uc:
@@ -88,9 +96,24 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
     def pidx(g, h):
         return ip + g * H + h
 
+    # min-up/min-down windows per unit: tile the base table to however
+    # many units the fleet actually has (n_units trims the base fleet,
+    # fleet_multiplier replicates it — both change G)
+    nb = len(_FLEET) if n_units is None else n_units
+    ut = np.tile(_UT[:nb], (G + nb - 1) // nb)[:G]
+    dt_ = np.tile(_DT[:nb], (G + nb - 1) // nb)[:G]
+    mud_rows = []
+    if min_up_down:
+        for g in range(G):
+            for h in range(1, H):
+                for tau in range(h + 1, min(h + int(ut[g]), H)):
+                    mud_rows.append(("up", g, h, tau))
+                for tau in range(h + 1, min(h + int(dt_[g]), H)):
+                    mud_rows.append(("dn", g, h, tau))
+
     # rows: pmax (GH), pmin (GH), balance (H), startup (GH),
-    # ramp up (G(H-1)), ramp down (G(H-1))
-    M = 3 * G * H + H + 2 * G * (H - 1)
+    # ramp up (G(H-1)), ramp down (G(H-1)), min up/down windows
+    M = 3 * G * H + H + 2 * G * (H - 1) + len(mud_rows)
     SA = 1 if shared_A else S   # matrix is scenario-independent
     A = np.zeros((SA, M, N), dtype=dtype)
     row_lo = np.full((S, M), -INF, dtype=dtype)
@@ -137,6 +160,20 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
             A[:, r, pidx(g, h - 1)] = 1.0
             row_hi[:, r] = ramp[g]
             r += 1
+    # min-up: u_tau >= u_h - u_{h-1}  ->  u_h - u_{h-1} - u_tau <= 0
+    # min-down: (u_{h-1} - u_h) + u_tau <= 1
+    for kind, g, h, tau in mud_rows:
+        if kind == "up":
+            A[:, r, uidx(g, h)] = 1.0
+            A[:, r, uidx(g, h - 1)] = -1.0
+            A[:, r, uidx(g, tau)] = -1.0
+            row_hi[:, r] = 0.0
+        else:
+            A[:, r, uidx(g, h - 1)] = 1.0
+            A[:, r, uidx(g, h)] = -1.0
+            A[:, r, uidx(g, tau)] = 1.0
+            row_hi[:, r] = 1.0
+        r += 1
     assert r == M
 
     lb = np.zeros((S, N), dtype=dtype)
@@ -293,8 +330,12 @@ def inparser_adder(cfg):
     cfg.add_to_config("uc_fleet_multiplier",
                       description="replicate the 3-unit fleet this "
                       "many times (jittered)", domain=int, default=1)
+    cfg.add_to_config("uc_min_up_down",
+                      description="enforce per-unit minimum up/down "
+                      "times", domain=bool, default=False)
 
 
 def kw_creator(options):
     return {"H": options.get("uc_hours", 6),
-            "fleet_multiplier": options.get("uc_fleet_multiplier", 1)}
+            "fleet_multiplier": options.get("uc_fleet_multiplier", 1),
+            "min_up_down": options.get("uc_min_up_down", False)}

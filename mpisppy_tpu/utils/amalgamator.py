@@ -95,8 +95,20 @@ class Amalgamator:
         return None, names, m.scenario_creator, kw
 
     def run(self):
-        cfg = self.cfg
+        import time as _time
+        t0 = _time.time()
         batch, names, creator, ckw = self._make_batch_and_names()
+        # wall split for corpus timing (run_all.py): batch lowering vs
+        # the solve (whose first iteration carries the jit compiles)
+        self.wall_build = _time.time() - t0
+        t0 = _time.time()
+        try:
+            return self._run_built(batch, names, creator, ckw)
+        finally:
+            self.wall_run = _time.time() - t0
+
+    def _run_built(self, batch, names, creator, ckw):
+        cfg = self.cfg
         opts = cfg.options_dict()
         if self.is_EF:
             opts["pdhg_eps"] = cfg.get("EF_solver_eps",

@@ -157,3 +157,39 @@ def test_uc_one_opt_smoke():
     cand, v1 = uc.one_opt_commitment(ph, b, all_on, max_sweeps=2,
                                      flip_slots=np.arange(6))
     assert v1 <= v0 + 1e-6
+
+
+def test_uc_min_up_down_rows():
+    """min_up_down=True adds the egret-style uptime/downtime window
+    rows: a commitment that starts a big unit for a single hour
+    violates its min-up window; honoring the window satisfies it."""
+    b = uc.build_batch(4, H=6, min_up_down=True)
+    b0 = uc.build_batch(4, H=6)
+    assert b.num_rows > b0.num_rows
+    A = np.asarray(b.A)[0]
+    hi = np.asarray(b.row_hi)[0]
+    G, H = 3, 6
+    GH = G * H
+
+    def commit(u):
+        x = np.zeros(b.num_vars)
+        x[:GH] = u.reshape(-1)
+        return x
+
+    # big unit (g=0, UT=3) on for exactly one hour (h=2): min-up rows
+    # u_3 - u_2 - u_tau <= 0 must be violated for tau in {4, 5}... in
+    # 0-based: start at h=2 (u[2]=1, u[1]=0) with u[3]=u[4]=0
+    u_bad = np.zeros((G, H))
+    u_bad[0, 2] = 1.0
+    viol = A @ commit(u_bad) - np.where(np.isfinite(hi), hi, np.inf)
+    assert np.max(viol) > 0.5            # some min-up row violated
+    # honoring the 3-hour window satisfies every extra row
+    u_ok = np.zeros((G, H))
+    u_ok[0, 2:5] = 1.0
+    viol2 = A @ commit(u_ok) - np.where(np.isfinite(hi), hi, np.inf)
+    assert np.max(viol2[b0.num_rows:]) <= 1e-9
+    # min-down: shutting the big unit for one hour then restarting
+    u_cyc = np.ones((G, H))
+    u_cyc[0, 3] = 0.0
+    viol3 = A @ commit(u_cyc) - np.where(np.isfinite(hi), hi, np.inf)
+    assert np.max(viol3[b0.num_rows:]) > 0.5
