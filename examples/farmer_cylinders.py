@@ -20,6 +20,8 @@ def _parse_args(args=None):
 
 
 def main(args=None):
+    import time as _time
+    t0 = _time.time()
     cfg = _parse_args(args)
     num_scens = cfg.num_scens
     names = farmer.scenario_names_creator(num_scens)
@@ -38,10 +40,13 @@ def main(args=None):
         vanilla.add_multi_rho(hub, cfg)
     spokes = vanilla.build_spokes(cfg, farmer.scenario_creator, None,
                                   names, batch=batch)
+    t1 = _time.time()
 
     ws = WheelSpinner(hub, spokes).spin()
     print(f"BestInnerBound = {ws.BestInnerBound}")
     print(f"BestOuterBound = {ws.BestOuterBound}")
+    print(f"DRIVER_WALL build={t1 - t0:.2f}s "
+          f"run={_time.time() - t1:.2f}s")
     if cfg.get("solution_base_name") and \
             ws.best_nonant_solution() is not None:
         ws.write_first_stage_solution(cfg["solution_base_name"] + ".csv")

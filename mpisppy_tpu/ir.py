@@ -105,6 +105,10 @@ class ScenarioBatch:
     # scenario probabilities uniformly across slots.
     var_prob: Any = None
     var_names: tuple = ()   # static, length N (reporting only)
+    # model-specific static metadata (e.g. UC's min-up/down window
+    # tables) — carried so helpers never re-derive structure baked
+    # into A; preserved by pad/densify (dataclasses.replace)
+    model_meta: Any = None
 
     @property
     def num_scens(self):
@@ -160,6 +164,7 @@ _register(
     data_fields=(
         "c", "qdiag", "A", "row_lo", "row_hi", "lb", "ub", "obj_const",
         "nonant_idx", "integer_mask", "tree", "stage_cost_c", "var_prob",
+        "model_meta",
     ),
     meta_fields=("var_names",),
 )
@@ -329,4 +334,5 @@ def pad_scenarios(batch: ScenarioBatch, to: int) -> ScenarioBatch:
         var_prob=None if batch.var_prob is None
         else padfield(batch.var_prob, 0.0),
         var_names=batch.var_names,
+        model_meta=batch.model_meta,
     )

@@ -98,8 +98,10 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
 
     # min-up/min-down windows per unit: tile the base table to however
     # many units the fleet actually has (n_units trims the base fleet,
-    # fleet_multiplier replicates it — both change G)
-    nb = len(_FLEET) if n_units is None else n_units
+    # fleet_multiplier replicates it — both change G).  These tables
+    # are also stored on the batch (model_meta) so candidate repair
+    # uses EXACTLY what A encodes, never a re-derivation.
+    nb = min(len(_FLEET) if n_units is None else n_units, len(_FLEET))
     ut = np.tile(_UT[:nb], (G + nb - 1) // nb)[:G]
     dt_ = np.tile(_DT[:nb], (G + nb - 1) // nb)[:G]
     mud_rows = []
@@ -222,11 +224,54 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
         A=A, row_lo=row_lo, row_hi=row_hi, lb=lb, ub=ub,
         obj_const=np.zeros((S,), dtype=dtype),
         nonant_idx=nonant_idx, integer_mask=integer_mask,
-        tree=tree, stage_cost_c=stage_cost_c, var_names=var_names)
+        tree=tree, stage_cost_c=stage_cost_c, var_names=var_names,
+        model_meta={"uc_H": H, "uc_G": G,
+                    "uc_ut": ut, "uc_dt": dt_,
+                    "uc_min_up_down": bool(min_up_down)})
 
 
 def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
+
+
+def repair_min_up_down(u, ut, dt_, H):
+    """Repair a (G*H,) rounded commitment to honor per-unit min-up/
+    min-down windows: every on-run is extended forward to >= UT hours,
+    then every off-run to >= DT hours (extension over-commits — the
+    cheap direction; shedding at the penalty price is the expensive
+    one).  Idempotent on window-feasible commitments."""
+    u = np.asarray(u, float).copy()
+    G = u.size // H
+    for g in range(G):
+        blk = u[g * H:(g + 1) * H]
+        # extend on-runs to UT
+        h = 0
+        while h < H:
+            if blk[h] == 1.0 and (h == 0 or blk[h - 1] == 0.0):
+                run = 0
+                while h + run < H and blk[h + run] == 1.0:
+                    run += 1
+                need = int(ut[g]) - run
+                for k in range(h + run, min(h + run + max(need, 0), H)):
+                    blk[k] = 1.0
+                h += max(run, 1)
+            else:
+                h += 1
+        # merge off-runs shorter than DT (turn them on)
+        h = 0
+        while h < H:
+            if blk[h] == 0.0 and h > 0 and blk[h - 1] == 1.0:
+                run = 0
+                while h + run < H and blk[h + run] == 0.0:
+                    run += 1
+                ends_inside = h + run < H      # off-run then back on
+                if ends_inside and run < int(dt_[g]):
+                    blk[h:h + run] = 1.0
+                h += max(run, 1)
+            else:
+                h += 1
+        u[g * H:(g + 1) * H] = blk
+    return u
 
 
 def commitment_candidate(batch, xbar_row, threshold=0.5):
@@ -247,6 +292,15 @@ def commitment_candidate(batch, xbar_row, threshold=0.5):
     K = vals.size
     GH = K // 2
     u = (np.clip(vals[:GH], 0, 1) > threshold).astype(float)
+    # when the batch carries min-up/min-down rows, a bare rounding is
+    # usually window-infeasible; repair by extending runs (over-commit
+    # — the cheap direction vs shedding).  The window tables come from
+    # the batch's own metadata, i.e. exactly what A encodes.
+    meta = batch.model_meta or {}
+    if meta.get("uc_min_up_down"):
+        u = repair_min_up_down(u, np.asarray(meta["uc_ut"]),
+                               np.asarray(meta["uc_dt"]),
+                               int(meta["uc_H"]))
     return np.concatenate([u, _derive_startups(batch, u)])
 
 

@@ -193,3 +193,37 @@ def test_uc_min_up_down_rows():
     u_cyc[0, 3] = 0.0
     viol3 = A @ commit(u_cyc) - np.where(np.isfinite(hi), hi, np.inf)
     assert np.max(viol3[b0.num_rows:]) > 0.5
+
+
+def test_uc_commitment_repair_windows():
+    """Threshold candidates on a min_up_down batch are repaired to
+    window feasibility (runs extended), so the recovery pipeline keeps
+    producing feasible incumbents with the windows on."""
+    S = 6
+    b = uc.build_batch(S, H=6, min_up_down=True)
+    # a single-hour spike for the big unit (UT=3) must stretch to 3h;
+    # the tables come from the batch's own metadata
+    ut = np.asarray(b.model_meta["uc_ut"])
+    dt_ = np.asarray(b.model_meta["uc_dt"])
+    u = np.zeros(18)
+    u[2] = 1.0                       # unit 0, hour 2
+    rep = uc.repair_min_up_down(u, ut, dt_, 6)
+    assert rep[2:5].sum() == 3.0     # extended to the 3-hour window
+    # a 1-hour off-gap inside an on-run gets merged (DT=3)
+    u2 = np.ones(18)
+    u2[3] = 0.0
+    rep2 = uc.repair_min_up_down(u2, ut, dt_, 6)
+    assert rep2[:6].sum() == 6.0
+    # end-to-end: PH consensus -> candidates stay feasible
+    ph = PH({"defaultPHrho": 50.0, "PHIterLimit": 3, "convthresh": 0.0,
+             "pdhg_eps": 1e-6, "pdhg_max_iters": 100000},
+            [f"s{i}" for i in range(S)], batch=b)
+    ph.Iter0()
+    for _ in range(3):
+        ph.ph_iteration()
+    cands = uc.commitment_candidates(b, np.asarray(ph.state.xbar)[0])
+    objs, feas = ph.evaluate_candidates(cands)
+    assert np.any(feas)
+    best = int(np.flatnonzero(feas)[np.argmin(objs[np.asarray(feas)])])
+    inner, cfeas = ph.evaluate_xhat(cands[best])
+    assert cfeas and np.isfinite(inner)
