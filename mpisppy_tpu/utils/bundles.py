@@ -41,10 +41,15 @@ def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
     na = np.asarray(batch.nonant_idx)
     A = np.asarray(batch.A)
     prob = np.asarray(batch.prob)
+    # a shared-A batch bundles to a shared-A batch: every bundle's
+    # block-diagonal is the same matrix (A identical across members,
+    # nonant-chain rows constant), so Ab stays (1, Mb, Nb) and the
+    # bmatvec matmul fast path survives bundling
+    shared = batch.shared_A
 
     Nb = m * N
     Mb = m * M + (m - 1) * K
-    Ab = np.zeros((B, Mb, Nb))
+    Ab = np.zeros((1 if shared else B, Mb, Nb))
     lob = np.full((B, Mb), -INF)
     hib = np.full((B, Mb), INF)
     cb = np.zeros((B, Nb))
@@ -72,7 +77,8 @@ def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
             w = prob[s] / pB if pB > 0 else 1.0 / m
             sl = slice(j * N, (j + 1) * N)
             rw = slice(j * M, (j + 1) * M)
-            Ab[b, rw, sl] = A[s]
+            if not shared:
+                Ab[b, rw, sl] = A[s]
             lob[b, rw] = lo[s]
             hib[b, rw] = hi[s]
             cb[b, sl] = w * c[s]
@@ -81,14 +87,26 @@ def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
             ubb[b, sl] = ub[s]
             intb[b, sl] = im[s]
             constb[b] += w * oc[s]
-        # nonant chains: member j's nonants == member 0's
+        # nonant chains: member j's nonants == member 0's (equality
+        # row bounds per bundle; the matrix entries per A block below)
+        lob[b, m * M:] = 0.0
+        hib[b, m * M:] = 0.0
+        if not shared:
+            for j in range(1, m):
+                for k in range(K):
+                    r = m * M + (j - 1) * K + k
+                    Ab[b, r, na[k]] = 1.0
+                    Ab[b, r, j * N + na[k]] = -1.0
+    if shared:
+        # ONE block-diagonal serves every bundle (members share A and
+        # the chain rows are constant)
+        for j in range(m):
+            Ab[0, j * M:(j + 1) * M, j * N:(j + 1) * N] = A[0]
         for j in range(1, m):
             for k in range(K):
                 r = m * M + (j - 1) * K + k
-                Ab[b, r, na[k]] = 1.0
-                Ab[b, r, j * N + na[k]] = -1.0
-                lob[b, r] = 0.0
-                hib[b, r] = 0.0
+                Ab[0, r, na[k]] = 1.0
+                Ab[0, r, j * N + na[k]] = -1.0
 
     names = batch.tree.scen_names or tuple(str(i) for i in range(S))
     tree = TreeInfo(

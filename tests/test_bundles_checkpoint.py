@@ -95,3 +95,32 @@ def test_w_csv_roundtrip(tmp_path):
     write_W_csv(path, ph)
     W = read_W_csv(path, ph)
     assert np.allclose(W[:3], np.asarray(ph.state.W)[:3])
+
+
+def test_bundle_shared_A_stays_shared():
+    """Bundling a shared-A batch keeps ONE block-diagonal matrix
+    (members share A, chain rows are constant), and the bundled system
+    matches the densely-bundled one exactly."""
+    import dataclasses
+
+    import numpy as np
+
+    from mpisppy_tpu.models import uc
+    from mpisppy_tpu.utils.bundles import bundle_batch
+
+    b_shared = uc.build_batch(8, H=4)
+    assert b_shared.shared_A
+    bb_s = bundle_batch(b_shared, 4)
+    assert bb_s.A.shape[0] == 1 and bb_s.num_scens == 2
+    assert bb_s.shared_A
+
+    b_dense = uc.build_batch(8, H=4, shared_A=False)
+    bb_d = bundle_batch(b_dense, 4)
+    assert bb_d.A.shape[0] == 2
+    A_s = np.asarray(bb_s.A)[0]
+    for bidx in range(2):
+        assert np.array_equal(A_s, np.asarray(bb_d.A)[bidx])
+    for f in ("row_lo", "row_hi", "c", "qdiag", "lb", "ub",
+              "obj_const"):
+        assert np.allclose(np.asarray(getattr(bb_s, f)),
+                           np.asarray(getattr(bb_d, f))), f
