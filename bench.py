@@ -206,11 +206,16 @@ def worker():
         # inject JAX_ENABLE_X64 (direct --worker runs, plugin
         # degradation) — so device=cpu always means the f64 protocol
         jax.config.update("jax_enable_x64", True)
-    # full size on the accelerator; a smaller default on the CPU
-    # fallback so a dead tunnel still yields a finished run (explicit
-    # BENCH_SCENS always wins)
-    fallback_sized = not on_tpu and "BENCH_SCENS" not in os.environ
-    S = int(os.environ.get("BENCH_SCENS", 1000 if on_tpu else 250))
+    # FULL size by default on both backends: measured r4, the S=1000
+    # f64 CPU run closes the verified 1% gap in ~11 min (667 s timed,
+    # vs_baseline 4.41) — affordable, and it reports the REAL metric.
+    # The orchestrator retries a reduced size if this worker times out
+    # (flagged via BENCH_NOTE_FALLBACK so the annotation survives the
+    # explicit BENCH_SCENS it sets).
+    fallback_sized = not on_tpu and (
+        "BENCH_SCENS" not in os.environ
+        or os.environ.get("BENCH_NOTE_FALLBACK") == "1")
+    S = int(os.environ.get("BENCH_SCENS", 1000))
     mult = int(os.environ.get("BENCH_MULT", 10))
     # the 2939.1 s Gurobi baseline is the S=1000, crops_multiplier=10
     # protocol; any other size is a different instance and must not
@@ -271,8 +276,8 @@ def worker():
                               4),
     }
     if fallback_sized:
-        extra["note_size"] = (f"reduced size (S={S}): accelerator "
-                              "unavailable, CPU fallback")
+        extra["note_size"] = ("accelerator unavailable: CPU fallback "
+                              f"at S={S} (f64)")
     metric = ("farmer1000_ph_seconds_to_1pct_gap" if at_baseline_size
               else "farmer_reduced_ph_seconds_to_1pct_gap")
     if gap > 0.01:
@@ -307,6 +312,15 @@ def main():
         cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", 5400))
         line = _run_worker({"JAX_PLATFORMS": "cpu",
                             "JAX_ENABLE_X64": "1"}, cpu_timeout)
+    if line is None and "BENCH_SCENS" not in os.environ:
+        # last resort: reduced size so a constrained box still yields
+        # an honest (differently-named) number
+        line = _run_worker({"JAX_PLATFORMS": "cpu",
+                            "JAX_ENABLE_X64": "1",
+                            "BENCH_SCENS": "250",
+                            "BENCH_NOTE_FALLBACK": "1"},
+                           float(os.environ.get("BENCH_CPU2_TIMEOUT",
+                                                1800)))
     if line is None:
         line = json.dumps({
             "metric": "farmer_reduced_ph_seconds_to_1pct_gap",

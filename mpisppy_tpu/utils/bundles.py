@@ -123,6 +123,7 @@ def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
         obj_const=constb, nonant_idx=batch.nonant_idx,
         integer_mask=intb, tree=tree,
         stage_cost_c=None,
+        model_meta=batch.model_meta,
         var_names=tuple(f"m{j}.{v}" for j in range(m)
                         for v in (batch.var_names
                                   or tuple(str(i) for i in range(N)))))

@@ -101,12 +101,7 @@ def test_bundle_shared_A_stays_shared():
     """Bundling a shared-A batch keeps ONE block-diagonal matrix
     (members share A, chain rows are constant), and the bundled system
     matches the densely-bundled one exactly."""
-    import dataclasses
-
-    import numpy as np
-
     from mpisppy_tpu.models import uc
-    from mpisppy_tpu.utils.bundles import bundle_batch
 
     b_shared = uc.build_batch(8, H=4)
     assert b_shared.shared_A
