@@ -43,6 +43,49 @@ class Hub(SPCommunicator):
         # sync() (single-program scheduling, SURVEY.md §7.6); threaded
         # mode clears this and spokes loop in their own threads
         self.drive_spokes_inline = True
+        # graceful degradation (beyond the reference, where a lost MPI
+        # rank aborts the job): a spoke whose step raises is REMOVED
+        # from the wheel — its wiring indices are pruned so the hub
+        # neither feeds it nor accepts anything further from it — and
+        # the run completes on the hub's own valid bounds.  Threaded
+        # spokes report failures through a queue drained on the hub
+        # thread (the index sets must not be mutated concurrently).
+        self.failed_spokes = []
+        self._failed_queue = []
+
+    def _mark_spoke_failed(self, i, exc):
+        """Prune spoke i out of every wiring set (hub thread only)."""
+        sp = self.spokes[i]
+        sp._failed = True
+        for idx_set in (self.outerbound_idx, self.innerbound_idx,
+                        self.w_idx, self.nonant_idx_set):
+            idx_set.discard(i)
+        self.has_outerbound_spokes = bool(self.outerbound_idx)
+        self.has_innerbound_spokes = bool(self.innerbound_idx)
+        self.failed_spokes.append((type(sp).__name__, str(exc)))
+        global_toc(f"WARNING: spoke {type(sp).__name__} failed and "
+                   f"was removed from the wheel: {exc}")
+
+    def report_spoke_failure(self, spoke, exc):
+        """Thread-safe failure report (threaded-mode spoke threads);
+        applied by _drain_failures on the hub thread."""
+        self._failed_queue.append((spoke, exc))
+
+    def _drain_failures(self):
+        while self._failed_queue:
+            spoke, exc = self._failed_queue.pop(0)
+            i = self.spokes.index(spoke)
+            if not getattr(spoke, "_failed", False):
+                self._mark_spoke_failed(i, exc)
+
+    def _step_spokes(self):
+        for i, sp in enumerate(self.spokes):
+            if getattr(sp, "_failed", False):
+                continue
+            try:
+                sp.step()
+            except Exception as e:
+                self._mark_spoke_failed(i, e)
 
     # -- wiring (reference hub.py:297-368 initialize_spoke_indices +
     #    make_windows) ----------------------------------------------------
@@ -177,6 +220,7 @@ class Hub(SPCommunicator):
             pair.to_spoke.send_kill()
 
     def hub_finalize(self):
+        self._drain_failures()
         self.receive_outerbounds()
         self.receive_innerbounds()
         global_toc("Statistics at termination")
@@ -200,11 +244,11 @@ class PHHub(Hub):
         self._iter_for_trace = 0
 
     def sync(self):
+        self._drain_failures()
         self.send_ws()
         self.send_nonants()
         if self.drive_spokes_inline:
-            for sp in self.spokes:
-                sp.step()
+            self._step_spokes()
         self.receive_outerbounds()
         self.receive_innerbounds()
 
@@ -269,11 +313,11 @@ class LShapedHub(Hub):
                 "LShapedHub cannot feed W spokes (reference hub.py:628)")
 
     def sync(self, send_nonants=True):
+        self._drain_failures()
         if send_nonants:
             self.send_nonants()
         if self.drive_spokes_inline:
-            for sp in self.spokes:
-                sp.step()
+            self._step_spokes()
         self.receive_outerbounds()
         self.receive_innerbounds()
 

@@ -87,7 +87,17 @@ class WheelSpinner:
 
         if self.mode == "threads" and spokes:
             hub.drive_spokes_inline = False
-            threads = [threading.Thread(target=sp.main, daemon=True)
+
+            def guarded_main(sp):
+                try:
+                    sp.main()
+                except Exception as e:
+                    # report to the hub thread (index pruning must not
+                    # race the hub's own set iteration)
+                    hub.report_spoke_failure(sp, e)
+
+            threads = [threading.Thread(target=guarded_main, args=(sp,),
+                                        daemon=True)
                        for sp in spokes]
             for t in threads:
                 t.start()
@@ -103,8 +113,12 @@ class WheelSpinner:
             hub.main()
             hub.send_terminate()
 
-        # final spoke passes (reference :129-139 "finalize")
+        # final spoke passes (reference :129-139 "finalize") — a spoke
+        # that failed mid-run is fully out of the wheel: no final pass
+        # (its state is suspect and its wiring is already pruned)
         for sp in spokes:
+            if getattr(sp, "_failed", False):
+                continue
             try:
                 sp.finalize()
             except Exception as e:  # a failing final pass must not eat

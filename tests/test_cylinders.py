@@ -155,3 +155,47 @@ class TestHydroCylinders:
         gap = (ws.BestInnerBound - ws.BestOuterBound) / abs(
             ws.BestOuterBound)
         assert gap < 2e-2
+
+
+class TestFailureTolerance:
+    def test_spoke_crash_does_not_kill_wheel(self):
+        """Graceful degradation (beyond the reference, where a lost
+        MPI rank aborts the job): a spoke whose step() raises is
+        removed from the wheel; the hub completes with its own valid
+        bounds and records the failure."""
+
+        class ExplodingSpoke(LagrangianOuterBound):
+            def step(self):
+                raise RuntimeError("synthetic spoke crash")
+
+        ws = farmer_wheel([(ExplodingSpoke, PH),
+                           (XhatShuffleInnerBound, Xhat_Eval)])
+        ws.spin()
+        hub = ws.spcomm
+        assert len(hub.failed_spokes) == 1
+        assert hub.failed_spokes[0][0] == "ExplodingSpoke"
+        assert "synthetic spoke crash" in hub.failed_spokes[0][1]
+        # the healthy inner-bound spoke and the hub's own bounds
+        # still produce a usable answer
+        assert np.isfinite(ws.BestInnerBound)
+        assert np.isfinite(ws.BestOuterBound)
+        assert ws.BestOuterBound <= ws.BestInnerBound + 1.0
+        assert abs(ws.BestInnerBound - -108390.0) < 50.0
+
+    def test_spoke_crash_threaded_mode(self):
+        """Threaded mode: the crash is reported from the spoke thread
+        and pruned on the hub thread."""
+
+        class ExplodingSpoke(LagrangianOuterBound):
+            def step(self):
+                raise RuntimeError("synthetic thread crash")
+
+        ws = farmer_wheel([(ExplodingSpoke, PH),
+                           (XhatShuffleInnerBound, Xhat_Eval)],
+                          mode="threads")
+        ws.spin()
+        hub = ws.spcomm
+        assert len(hub.failed_spokes) == 1
+        assert hub.failed_spokes[0][0] == "ExplodingSpoke"
+        assert np.isfinite(ws.BestInnerBound)
+        assert np.isfinite(ws.BestOuterBound)
