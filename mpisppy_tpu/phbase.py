@@ -246,6 +246,7 @@ class PHBase(SPOpt):
                               dtiming=self.options.get("display_timing"),
                               certify="feas")
         feas = self.feas_prob(res)
+        self.iter0_feas_mass = float(feas)   # benchmarks report this
         if feas < 1.0 - 1e-6:
             # reference hard-quits on infeasible iter0 (phbase.py:817
             # "quitting after iter 0 because of infeasibility");
