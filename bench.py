@@ -139,7 +139,7 @@ def worker_uc():
     S = int(os.environ.get("BENCH_SCENS", 1000))
     fm = int(os.environ.get("BENCH_UC_FLEET", 7 if on_tpu else 2))
     H = int(os.environ.get("BENCH_UC_HOURS", 24 if on_tpu else 6))
-    iters = int(os.environ.get("BENCH_UC_ITERS", 10))
+    iters = int(os.environ.get("BENCH_UC_ITERS", 25 if on_tpu else 10))
 
     b = uc.build_batch(S, H=H, fleet_multiplier=fm,
                        dtype=np.float32 if on_tpu else np.float64)
@@ -171,8 +171,19 @@ def worker_uc():
     ok = np.flatnonzero(feas)
     inner, cfeas = (np.inf, False)
     if ok.size:
-        inner, cfeas = ph.evaluate_xhat(
-            cands[int(ok[np.argmin(objs[ok])])])
+        best = cands[int(ok[np.argmin(objs[ok])])]
+        # 1-opt local search over the AMBIGUOUS slots only (fractional
+        # consensus); capped so each sweep is one bounded stacked
+        # launch.  This is the slam/xhat-heuristic analog that pulls
+        # the recovered commitment toward the MIP optimum.
+        GH = best.size // 2
+        xu = np.clip(xbar[:GH], 0.0, 1.0)
+        frac = np.flatnonzero((xu > 0.02) & (xu < 0.98))
+        if frac.size > 48:
+            frac = frac[np.argsort(np.abs(xu[frac] - 0.5))[:48]]
+        best, inner = uc.one_opt_commitment(ph, b, best,
+                                            flip_slots=frac)
+        cfeas = bool(np.isfinite(inner))
     jax.block_until_ready(ph.state.x)
     wall = time.time() - t0
     stats = ph.solve_stats()

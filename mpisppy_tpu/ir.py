@@ -170,22 +170,92 @@ _register(
 )
 
 
+@dataclasses.dataclass(frozen=True)
+class SplitA:
+    """Constraint batch in shared + sparse-delta form:
+
+        A(s) = shared  +  scatter((rows, cols) -> vals[s])
+
+    The TPU-native representation for families whose MATRIX uncertainty
+    touches only a few coordinates per scenario (farmer: the per-crop
+    yield coefficients — 2*n_crops entries out of M*N).  The batched
+    matvec then runs as ONE (S, N) x (N, M) matmul on the MXU plus an
+    nnz-sized scatter, instead of an (S, M, N) batched GEMV: per-
+    iteration HBM traffic drops from S*M*N to M*N + S*nnz — the same
+    trick as ScenarioBatch.shared_A (row-bound uncertainty), extended
+    to matrix uncertainty.  `shared` stores ZEROS at the delta
+    positions, so the scatter ADD needs no masking.
+
+    Models declare the delta coordinate set via
+    model_meta["A_delta_idx"] = (rows, cols); SPOpt then builds the
+    split PreparedBatch (ops/pdhg.prepare_batch_split) while batch.A
+    itself stays dense for the code paths that index it by scenario
+    (MIP dives, Benders cuts, Schur assembly).
+    """
+
+    shared: Any   # (M, N) scenario-independent part (0 at delta slots)
+    rows: Any     # (nnz,) int32 row of each per-scenario entry
+    cols: Any     # (nnz,) int32 column of each per-scenario entry
+    vals: Any     # (S, nnz) per-scenario values at (rows, cols)
+
+    @property
+    def shape(self):
+        return (self.vals.shape[0],) + tuple(self.shared.shape)
+
+    @property
+    def ndim(self):
+        return 3
+
+    @property
+    def dtype(self):
+        return self.shared.dtype
+
+    def to_dense(self):
+        S = self.vals.shape[0]
+        A = jnp.broadcast_to(self.shared[None],
+                             (S,) + tuple(self.shared.shape))
+        return A.at[:, self.rows, self.cols].add(self.vals)
+
+
+_register(SplitA, data_fields=("shared", "rows", "cols", "vals"),
+          meta_fields=())
+
+
+def delta_idx(batch):
+    """The batch's declared sparse matrix-uncertainty coordinates
+    (model_meta["A_delta_idx"] -> (rows, cols) numpy int arrays), or
+    None.  ONE accessor for the contract so every consumer (SPOpt prep,
+    the xhat reduced-system builder, bundling's remap) reads it the
+    same way."""
+    meta = batch.model_meta
+    if not isinstance(meta, dict):
+        return None
+    return meta.get("A_delta_idx")
+
+
 def bmatvec(A, x):
     """Batched A @ x: A (SA, M, N) with SA == S or SA == 1 (shared
-    constraint matrix), x (S, N) -> (S, M).
+    constraint matrix), or a SplitA; x (S, N) -> (S, M).
 
     The shared-A case is the TPU-native fast path for model families
     whose uncertainty lives in the ROW BOUNDS only (UC wind, many
     two-stage demand models): one (M, N) matrix turns the batched
     matvec into a real (S, N) x (N, M) matmul on the MXU and cuts the
-    constraint-tensor memory by S."""
+    constraint-tensor memory by S.  SplitA extends the same fast path
+    to sparse MATRIX uncertainty (shared matmul + nnz scatter)."""
+    if isinstance(A, SplitA):
+        out = x @ A.shared.T
+        return out.at[:, A.rows].add(A.vals * jnp.take(x, A.cols, axis=1))
     if A.shape[0] == 1:
         return x @ A[0].T
     return jnp.einsum("smn,sn->sm", A, x)
 
 
 def bmatvec_t(A, y):
-    """Batched A^T @ y: A (SA, M, N), y (S, M) -> (S, N)."""
+    """Batched A^T @ y: A (SA, M, N) or SplitA, y (S, M) -> (S, N)."""
+    if isinstance(A, SplitA):
+        out = y @ A.shared
+        return out.at[:, A.cols].add(A.vals * jnp.take(y, A.rows, axis=1))
     if A.shape[0] == 1:
         return y @ A[0]
     return jnp.einsum("smn,sm->sn", A, y)

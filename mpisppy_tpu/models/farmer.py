@@ -158,6 +158,12 @@ def build_batch(num_scens, crops_multiplier=1, use_integer=False,
         nonant_names=var_names[:nc],
         scen_names=tuple(f"scen{i}" for i in range(S)),
     )
+    # the ONLY scenario-varying matrix entries are the 2*nc yield
+    # coefficients (feed rows r x iac, limit-sold rows r2 x iac);
+    # declaring them lets SPOpt build the ir.SplitA fast path (shared
+    # matmul + nnz scatter instead of an (S, M, N) batched GEMV)
+    delta_rows = np.concatenate([r, r2]).astype(np.int32)
+    delta_cols = np.concatenate([iac, iac]).astype(np.int32)
     return ScenarioBatch(
         c=c, qdiag=np.zeros((S, N), dtype=dtype),
         A=A, row_lo=row_lo, row_hi=row_hi, lb=lb, ub=ub,
@@ -167,6 +173,7 @@ def build_batch(num_scens, crops_multiplier=1, use_integer=False,
         tree=tree,
         stage_cost_c=stage_cost_c,
         var_names=var_names,
+        model_meta={"A_delta_idx": (delta_rows, delta_cols)},
     )
 
 

@@ -44,6 +44,7 @@ from jax import lax
 
 # one shared-A broadcast dispatch rule for the whole package: the
 # SA == 1 fast path turns the batched matvec into a real matmul
+from ..ir import SplitA
 from ..ir import bmatvec as _Ax
 from ..ir import bmatvec_t as _ATy
 
@@ -183,6 +184,72 @@ def prepare_batch(A, row_lo, row_hi, ruiz_iters=10, shared_cols=False):
         # would otherwise yield ~0 and blow up the step sizes
         anorm=jnp.maximum(anorm, 1.0),
     )
+
+
+@partial(jax.jit, static_argnames=("ruiz_iters",))
+def prepare_batch_split(A, rows, cols, row_lo, row_hi, ruiz_iters=10):
+    """prepare_batch for a batch whose matrix uncertainty is confined
+    to the (rows, cols) coordinate set (ir.SplitA): A is the DENSE
+    (S, M, N) tensor the model built; every entry OUTSIDE the delta set
+    must be scenario-independent (the model's declaration via
+    model_meta["A_delta_idx"] is the contract — tests pin it).
+
+    Ruiz equilibration here uses ONE row/col scaling shared across
+    scenarios (norms taken as the max over scenarios), because a
+    per-scenario scaling would destroy the shared+sparse structure:
+    D_r(s) (A0 + d(s)) D_c(s) splits only when D_r/D_c are shared.
+    Shared scalings also satisfy the consensus solver's shared-column
+    requirement (prepare_batch(shared_cols=True)) for free.
+
+    Returns a PreparedBatch whose A is a SplitA and whose d_row/d_col
+    are (1, M)/(1, N) — the shared-A broadcasting convention.
+    """
+    S, M, N = A.shape
+    rows = jnp.asarray(rows, jnp.int32)
+    cols = jnp.asarray(cols, jnp.int32)
+    vals = A[:, rows, cols]                          # (S, nnz)
+    A0 = A[0].at[rows, cols].set(0.0)                # (M, N) shared part
+    eps = 1e-12
+
+    def body(_, carry):
+        A0s, vs, dr, dc = carry
+        vmax = jnp.max(jnp.abs(vs), axis=0)          # (nnz,) over scens
+        rmax = jnp.max(jnp.abs(A0s), axis=1).at[rows].max(vmax)
+        cmax = jnp.max(jnp.abs(A0s), axis=0).at[cols].max(vmax)
+        sr = jnp.where(rmax <= eps, 1.0,
+                       1.0 / jnp.sqrt(jnp.maximum(rmax, eps)))
+        sc = jnp.where(cmax <= eps, 1.0,
+                       1.0 / jnp.sqrt(jnp.maximum(cmax, eps)))
+        A0s = A0s * sr[:, None] * sc[None, :]
+        vs = vs * sr[rows] * sc[cols]
+        return A0s, vs, dr * sr, dc * sc
+
+    A0s, vs, dr, dc = lax.fori_loop(
+        0, ruiz_iters, body,
+        (A0, vals, jnp.ones((M,), A.dtype), jnp.ones((N,), A.dtype)))
+    As = SplitA(shared=A0s, rows=rows, cols=cols, vals=vs)
+    anorm = _power_iteration(As)
+    d_row = dr[None, :]
+    d_col = dc[None, :]
+    return PreparedBatch(
+        A=As,
+        row_lo=jnp.where(jnp.isfinite(row_lo), row_lo * d_row, row_lo),
+        row_hi=jnp.where(jnp.isfinite(row_hi), row_hi * d_row, row_hi),
+        d_row=d_row,
+        d_col=d_col,
+        anorm=jnp.maximum(anorm, 1.0),
+    )
+
+
+def _unscale_A(A, dr, dc):
+    """User-space view of a scaled constraint operator: A / dr / dc,
+    dispatching on representation (dense batched / shared / SplitA)."""
+    if isinstance(A, SplitA):
+        return SplitA(
+            shared=A.shared / dr[0][:, None] / dc[0][None, :],
+            rows=A.rows, cols=A.cols,
+            vals=A.vals / (dr[:, A.rows] * dc[:, A.cols]))
+    return A / dr[:, :, None] / dc[:, None, :]
 
 
 # --------------------------------------------------------------------------
@@ -430,6 +497,7 @@ class PDHGSolver:
             tau = 0.9 / (omega * anorm + 0.9 * qmax)
 
             if self.use_pallas and csum is None \
+                    and not isinstance(A, SplitA) \
                     and A.shape[0] == x.shape[0]:
                 # (the Pallas chunk kernel tiles per-scenario A slabs;
                 # shared-A batches use the XLA matmul path)
@@ -562,7 +630,7 @@ class PDHGSolver:
         # dual objective in user space (recompute residual pieces unscaled)
         _, _, _, _, dobj = _residuals(
             xu, yu, c, qdiag,
-            prep.A / dr[:, :, None] / dc[:, None, :],
+            _unscale_A(prep.A, dr, dc),
             jnp.where(jnp.isfinite(prep.row_lo), prep.row_lo / dr,
                       prep.row_lo),
             jnp.where(jnp.isfinite(prep.row_hi), prep.row_hi / dr,

@@ -63,6 +63,7 @@ from .ef import ExtensiveForm
 
 class ExtensiveFormMIP(ExtensiveForm):
     _needs_dense_A = True   # the dive indexes A by scenario
+    _use_split_prep = False  # _lp_multi tiles prep.A as a dense array
 
     def __init__(self, options, all_scenario_names, **kwargs):
         super().__init__(options, all_scenario_names, **kwargs)

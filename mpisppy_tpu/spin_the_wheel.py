@@ -69,10 +69,15 @@ class WheelSpinner:
             kw.setdefault("mesh", hub_opt.mesh)
             # share the hub's PreparedBatch too (Ruiz scaling + ||A||):
             # identical batch => identical prep, as long as the spoke's
-            # opt class uses the same column-scaling mode
+            # opt class uses the same column-scaling mode AND accepts
+            # the hub's prep representation (a class that tiles/indexes
+            # prep.A densely must not receive an ir.SplitA prep)
+            from .ir import SplitA
             if (kw.get("batch") is hub_opt.batch
                     and sd["opt_class"]._shared_cols
-                    == hd["opt_class"]._shared_cols):
+                    == hd["opt_class"]._shared_cols
+                    and (getattr(sd["opt_class"], "_use_split_prep", True)
+                         or not isinstance(hub_opt.prep.A, SplitA))):
                 kw.setdefault("prep", hub_opt.prep)
             sp_opt = sd["opt_class"](**kw)
             spoke = sd["spoke_class"](

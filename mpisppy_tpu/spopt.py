@@ -22,8 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import global_toc
-from .ir import bmatvec
-from .ops.pdhg import PDHGSolver, prepare_batch
+from .ir import bmatvec, delta_idx
+from .ops.pdhg import PDHGSolver, prepare_batch, prepare_batch_split
 from .spbase import SPBase
 from .utils import mfu as _mfu
 
@@ -32,6 +32,9 @@ class SPOpt(SPBase):
     # subclasses needing one column scaling shared across scenarios
     # (consensus/EF solves) set this so the batch is prepared once
     _shared_cols = False
+    # subclasses that tile / index prep.A as a dense array (the MIP
+    # dive's stacked bound-variants) opt out of the SplitA fast path
+    _use_split_prep = True
 
     def __init__(self, *args, prep=None, **kwargs):
         super().__init__(*args, **kwargs)
@@ -52,9 +55,22 @@ class SPOpt(SPBase):
             self.prep = prep
         else:
             global_toc("Preparing batch (Ruiz scaling + ||A|| estimate)")
-            self.prep = prepare_batch(
-                self.batch.A, self.batch.row_lo, self.batch.row_hi,
-                shared_cols=self._shared_cols)
+            delta = delta_idx(self.batch)
+            if (delta is not None and self._use_split_prep
+                    and not self.batch.shared_A
+                    and not o.get("no_split_prep")):
+                # sparse matrix uncertainty (ir.SplitA): shared-scaling
+                # Ruiz keeps the shared+delta structure, and shared
+                # columns satisfy _shared_cols implicitly
+                self.prep = prepare_batch_split(
+                    self.batch.A,
+                    jnp.asarray(delta[0], jnp.int32),
+                    jnp.asarray(delta[1], jnp.int32),
+                    self.batch.row_lo, self.batch.row_hi)
+            else:
+                self.prep = prepare_batch(
+                    self.batch.A, self.batch.row_lo, self.batch.row_hi,
+                    shared_cols=self._shared_cols)
         # warm-start caches (analog of persistent-solver state,
         # reference spopt.py:877 set_instance_retry — license logic gone)
         self._x_warm = None
@@ -443,7 +459,19 @@ class SPOpt(SPBase):
             na = na[pos]
         nai = jnp.asarray(na, jnp.int32)
         A_na = jnp.take(b.A, nai, axis=2)              # (S, M, Kf)
-        A_red = jnp.asarray(b.A).at[:, :, nai].set(0.0)
+        delta = delta_idx(b)
+        if (delta is not None and not b.shared_A
+                and not self.options.get("no_split_prep")
+                and np.all(np.isin(np.asarray(delta[1]), na))):
+            # every scenario-varying matrix entry sits in an ELIMINATED
+            # column (farmer: yields multiply the nonant acreages), so
+            # the reduced system is scenario-independent — store it
+            # (1, M, N) and every downstream solve rides the shared-A
+            # matmul fast path (the per-scenario part lives entirely in
+            # the A_na shift of the row bounds)
+            A_red = jnp.asarray(b.A[0:1]).at[:, :, nai].set(0.0)
+        else:
+            A_red = jnp.asarray(b.A).at[:, :, nai].set(0.0)
         c_na = jnp.take(b.c, nai, axis=1)
         q_na = jnp.take(b.qdiag, nai, axis=1)
         c_red = jnp.asarray(b.c).at[:, nai].set(0.0)

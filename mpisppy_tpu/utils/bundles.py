@@ -108,6 +108,24 @@ def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
                 Ab[0, r, na[k]] = 1.0
                 Ab[0, r, j * N + na[k]] = -1.0
 
+    # remap sparse matrix-uncertainty coordinates (ir.SplitA contract)
+    # to the bundled block-diagonal layout: member j's delta entry
+    # (r, c) lands at (j*M + r, j*N + c).  The shared part stays
+    # bundle-independent (identical member blocks + constant chain
+    # rows), so the split fast path survives bundling.
+    from ..ir import delta_idx
+    meta = dict(batch.model_meta) if isinstance(batch.model_meta, dict) \
+        else None
+    if meta and delta_idx(batch) is not None:
+        if shared:
+            del meta["A_delta_idx"]   # already on the shared-A path
+        else:
+            r0, c0 = (np.asarray(v) for v in delta_idx(batch))
+            meta["A_delta_idx"] = (
+                np.concatenate([j * M + r0 for j in range(m)]).astype(
+                    np.int32),
+                np.concatenate([j * N + c0 for j in range(m)]).astype(
+                    np.int32))
     names = batch.tree.scen_names or tuple(str(i) for i in range(S))
     tree = TreeInfo(
         node_of=np.zeros((B, K), np.int32),
@@ -123,7 +141,7 @@ def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
         obj_const=constb, nonant_idx=batch.nonant_idx,
         integer_mask=intb, tree=tree,
         stage_cost_c=None,
-        model_meta=batch.model_meta,
+        model_meta=meta if meta is not None else batch.model_meta,
         var_names=tuple(f"m{j}.{v}" for j in range(m)
                         for v in (batch.var_names
                                   or tuple(str(i) for i in range(N)))))
