@@ -126,16 +126,15 @@ def worker_uc():
     the JSON records gap, wall, MFU."""
     import numpy as np
 
-    from mpisppy_tpu.utils.platform import ensure_cpu_backend
+    from mpisppy_tpu.utils.platform import (enable_f64_if_cpu,
+                                            ensure_cpu_backend)
     ensure_cpu_backend()
     import jax
 
     from mpisppy_tpu.models import uc
     from mpisppy_tpu.opt.ph import PH
 
-    on_tpu = jax.devices()[0].platform != "cpu"
-    if not on_tpu:
-        jax.config.update("jax_enable_x64", True)
+    on_tpu = not enable_f64_if_cpu()
     S = int(os.environ.get("BENCH_SCENS", 1000))
     fm = int(os.environ.get("BENCH_UC_FLEET", 7 if on_tpu else 2))
     H = int(os.environ.get("BENCH_UC_HOURS", 24 if on_tpu else 6))
@@ -220,20 +219,18 @@ def worker():
         return worker_uc()
     import numpy as np
 
-    from mpisppy_tpu.utils.platform import ensure_cpu_backend
+    from mpisppy_tpu.utils.platform import (enable_f64_if_cpu,
+                                            ensure_cpu_backend)
     ensure_cpu_backend()
     import jax
 
     from mpisppy_tpu.models import farmer
     from mpisppy_tpu.opt.ph import PH
 
-    on_tpu = jax.devices()[0].platform != "cpu"
-    if not on_tpu:
-        # the CPU protocol is f64 wherever the worker lands on CPU —
-        # including off-nominal landings where the parent didn't
-        # inject JAX_ENABLE_X64 (direct --worker runs, plugin
-        # degradation) — so device=cpu always means the f64 protocol
-        jax.config.update("jax_enable_x64", True)
+    # f64 wherever the worker lands on CPU — including off-nominal
+    # landings where the parent didn't inject JAX_ENABLE_X64 (direct
+    # --worker runs, plugin degradation)
+    on_tpu = not enable_f64_if_cpu()
     # FULL size by default on both backends: measured r4, the S=1000
     # f64 CPU run closes the verified 1% gap in ~11 min (667 s timed,
     # vs_baseline 4.41) — affordable, and it reports the REAL metric.

@@ -15,9 +15,11 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))          # repo root, for mpisppy_tpu
 
-from mpisppy_tpu.utils.platform import ensure_cpu_backend  # noqa: E402
+from mpisppy_tpu.utils.platform import (  # noqa: E402
+    enable_f64_if_cpu, ensure_cpu_backend)
 
 ensure_cpu_backend()        # no-op unless JAX_PLATFORMS requests cpu
+enable_f64_if_cpu()         # CPU runs follow the f64 protocol
 
 from mpisppy_tpu.utils import amalgamator, config  # noqa: E402
 

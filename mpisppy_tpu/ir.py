@@ -386,7 +386,12 @@ def pad_scenarios(batch: ScenarioBatch, to: int) -> ScenarioBatch:
     )
     # Dummy scenarios: feasible-by-construction (free rows, unit box).
     # A shared constraint matrix needs no padding — pads reuse it under
-    # free row bounds (any box point satisfies free rows).
+    # free row bounds (any box point satisfies free rows).  The same
+    # free-row argument keeps a model_meta["A_delta_idx"] declaration
+    # sound: split prep gives a zero-padded scenario the SHARED matrix
+    # instead of its literal zero matrix, which only free rows (and
+    # prob 0) make harmless — pad_scenarios must never emit pads with
+    # finite row bounds.
     return ScenarioBatch(
         c=padfield(batch.c),
         qdiag=padfield(batch.qdiag),

@@ -501,6 +501,17 @@ class ExtensiveFormMIP(ExtensiveForm):
                             global_toc(f"MIP dive {phase}: dead end — "
                                        f"released bulk fixes")
                         continue
+                    if gate_k.pop(int(vi), None) is not None:
+                        # the node-broadcast fix was the culprit (the
+                        # support-indicator equality held structurally
+                        # but the dive's earlier fixes made it binding
+                        # scenario-asymmetrically): demote this binary
+                        # to per-scenario fixing and re-probe
+                        if verbose:
+                            global_toc(f"MIP dive {phase}: dead end — "
+                                       f"col {vi} demoted to "
+                                       f"per-scenario fixing")
+                        continue
                     raise RuntimeError(
                         f"both strong-rounding directions infeasible "
                         f"at scenario {si}, col {vi} (phase {phase})")

@@ -21,3 +21,18 @@ def ensure_cpu_backend(force=False):
     xb._backend_factories.pop("axon", None)
     import jax
     jax.config.update("jax_platforms", "cpu")
+
+
+def enable_f64_if_cpu():
+    """The project-wide precision protocol: device=cpu always means
+    f64 (certified-eps paths — MIP diving at 1e-6, golden drives — are
+    not reliable in f32; f32 is the accelerator's trade, not the
+    host's).  Gates on the ACTUAL backend, so it initializes jax.
+    Returns True when the backend is CPU (callers branch on it for
+    CPU-vs-accelerator run protocol)."""
+    import jax
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        jax.config.update("jax_enable_x64", True)
+    return on_cpu
