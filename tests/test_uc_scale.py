@@ -157,6 +157,12 @@ def test_uc_one_opt_smoke():
     cand, v1 = uc.one_opt_commitment(ph, b, all_on, max_sweeps=2,
                                      flip_slots=np.arange(6))
     assert v1 <= v0 + 1e-6
+    # chunked sweeps (reference-scale fleets launch bounded stacks)
+    # must take the same improving path as one whole-sweep launch
+    cand2, v2 = uc.one_opt_commitment(ph, b, all_on, max_sweeps=2,
+                                      flip_slots=np.arange(6), chunk=2)
+    assert np.array_equal(cand, cand2)
+    assert abs(v1 - v2) <= 1e-9 * (1 + abs(v1))
 
 
 def test_uc_min_up_down_rows():
