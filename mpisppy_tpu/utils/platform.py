@@ -23,6 +23,29 @@ def ensure_cpu_backend(force=False):
     jax.config.update("jax_platforms", "cpu")
 
 
+def enable_compile_cache_if_cpu():
+    """Point jax at a persistent compilation cache when running on the
+    CPU backend (measured: repeat sizes-3 MIP runs drop 80.8 s ->
+    49.3 s — ~30 s of the wall is XLA compiles).  Accelerator runs are
+    left alone (their compile path may be remote/plugin-managed), and
+    an explicit JAX_COMPILATION_CACHE_DIR always wins."""
+    import jax
+
+    if jax.devices()[0].platform != "cpu":
+        return
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return
+    path = os.environ.get(
+        "MPISPPY_TPU_JAX_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "mpisppy_tpu_jax"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except (OSError, AttributeError):   # read-only home / old jax
+        pass
+
+
 def enable_f64_if_cpu():
     """The project-wide precision protocol: device=cpu always means
     f64 (certified-eps paths — MIP diving at 1e-6, golden drives — are
