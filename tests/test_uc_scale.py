@@ -238,3 +238,31 @@ def test_uc_commitment_repair_windows():
     best = int(np.flatnonzero(feas)[np.argmin(objs[np.asarray(feas)])])
     inner, cfeas = ph.evaluate_xhat(cands[best])
     assert cfeas and np.isfinite(inner)
+
+
+def test_ef_dual_bound_validity():
+    """The shared EF-dual outer bound helper (opt/ef.ef_dual_bound,
+    used by bench.py worker_uc and uc_scale_demo) must lower-bound any
+    feasible integer commitment's objective, and must beat the iter-0
+    trivial bound's slack at small iteration counts (the calibration
+    that cut the r4 UC artifact's reported gap from 17.7% to 4.1%)."""
+    from mpisppy_tpu.opt.ef import ef_dual_bound
+
+    S = 20
+    b = uc.build_batch(S, H=6, fleet_multiplier=2)
+    names = [f"s{i}" for i in range(S)]
+    bound, secs = ef_dual_bound(b, names)
+    assert np.isfinite(bound) and secs >= 0.0
+    ph = PH({"defaultPHrho": 50.0, "PHIterLimit": 2, "convthresh": 0.0,
+             "pdhg_eps": 1e-5, "pdhg_max_iters": 60000,
+             "iter0_infeasibility_ok": True},
+            names, batch=b)
+    ph.Iter0()
+    ph.ph_iteration()
+    # valid: below every feasible integer commitment
+    cands = uc.commitment_candidates(b, np.asarray(ph.state.xbar)[0])
+    objs, feas = ph.evaluate_candidates(cands)
+    ok = np.flatnonzero(feas)
+    assert ok.size and bound <= float(np.min(objs[ok])) + 1e-6
+    # and tighter than (or equal to) the trivial bound
+    assert bound >= ph.trivial_bound - 1e-6 * (1 + abs(bound))
