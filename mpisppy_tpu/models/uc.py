@@ -58,7 +58,7 @@ def wind_profile(scennum, H, seed=91):
 
 def build_batch(num_scens, H=6, n_units=None, seed=91,
                 fleet_multiplier=1, dtype=np.float64, shared_A=True,
-                min_up_down=False):
+                min_up_down=False, reserve_factor=0.0):
     """fleet_multiplier k replicates the 3-unit fleet k times with
     seeded parameter jitter and scales demand to match — the scaling
     axis of the reference's larger_uc instances (paperruns/larger_uc:
@@ -70,7 +70,19 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
     batched matvec into a real (S, N) x (N, M) matmul on the MXU
     (ir.bmatvec) and cuts the constraint-tensor memory by S, which is
     what makes the 1000-wind-scenario, 20+-unit, 24 h instances of the
-    reference's larger_uc study fit on one chip."""
+    reference's larger_uc study fit on one chip.
+
+    reserve_factor r > 0 adds the egret-style spinning-reserve rows
+    (one per hour): committed headroom sum_g (Pmax_g u_gh - p_gh)
+    must cover r * demand_h.  Load shedding does NOT satisfy reserve
+    — an under-committed hour is infeasible, not merely expensive —
+    which is what makes reserve bind the commitment the way the
+    reference's egret UC reserves do.  The rows are
+    scenario-independent (demand-based requirement), so shared_A is
+    preserved."""
+    if reserve_factor < 0:
+        raise ValueError(
+            f"reserve_factor must be >= 0, got {reserve_factor}")
     fleet = _FLEET if n_units is None else _FLEET[:n_units]
     if fleet_multiplier > 1:
         rng = np.random.RandomState(seed + 5)
@@ -114,8 +126,10 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
                     mud_rows.append(("dn", g, h, tau))
 
     # rows: pmax (GH), pmin (GH), balance (H), startup (GH),
-    # ramp up (G(H-1)), ramp down (G(H-1)), min up/down windows
-    M = 3 * G * H + H + 2 * G * (H - 1) + len(mud_rows)
+    # ramp up (G(H-1)), ramp down (G(H-1)), min up/down windows,
+    # spinning reserve (H, if reserve_factor > 0)
+    n_res = H if reserve_factor > 0 else 0
+    M = 3 * G * H + H + 2 * G * (H - 1) + len(mud_rows) + n_res
     SA = 1 if shared_A else S   # matrix is scenario-independent
     A = np.zeros((SA, M, N), dtype=dtype)
     row_lo = np.full((S, M), -INF, dtype=dtype)
@@ -176,6 +190,16 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
             A[:, r, uidx(g, tau)] = 1.0
             row_hi[:, r] = 1.0
         r += 1
+    # spinning reserve: sum_g (Pmax_g u_gh - p_gh) >= r * demand_h.
+    # No shed column — reserve is a commitment requirement, not an
+    # energy one
+    if n_res:
+        for h in range(H):
+            for g in range(G):
+                A[:, r, uidx(g, h)] = Pmax[g]
+                A[:, r, pidx(g, h)] = -1.0
+            row_lo[:, r] = reserve_factor * dem[h]
+            r += 1
     assert r == M
 
     lb = np.zeros((S, N), dtype=dtype)
@@ -227,7 +251,8 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
         tree=tree, stage_cost_c=stage_cost_c, var_names=var_names,
         model_meta={"uc_H": H, "uc_G": G,
                     "uc_ut": ut, "uc_dt": dt_,
-                    "uc_min_up_down": bool(min_up_down)})
+                    "uc_min_up_down": bool(min_up_down),
+                    "uc_reserve_factor": float(reserve_factor)})
 
 
 def scenario_names_creator(num_scens, start=0):
@@ -405,9 +430,14 @@ def inparser_adder(cfg):
     cfg.add_to_config("uc_min_up_down",
                       description="enforce per-unit minimum up/down "
                       "times", domain=bool, default=False)
+    cfg.add_to_config("uc_reserve_factor",
+                      description="spinning-reserve requirement as a "
+                      "fraction of hourly demand (0 disables)",
+                      domain=float, default=0.0)
 
 
 def kw_creator(options):
     return {"H": options.get("uc_hours", 6),
             "fleet_multiplier": options.get("uc_fleet_multiplier", 1),
-            "min_up_down": options.get("uc_min_up_down", False)}
+            "min_up_down": options.get("uc_min_up_down", False),
+            "reserve_factor": options.get("uc_reserve_factor", 0.0)}

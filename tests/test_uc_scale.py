@@ -266,3 +266,35 @@ def test_ef_dual_bound_validity():
     assert ok.size and bound <= float(np.min(objs[ok])) + 1e-6
     # and tighter than (or equal to) the trivial bound
     assert bound >= ph.trivial_bound - 1e-6 * (1 + abs(bound))
+
+
+def test_uc_spinning_reserve_rows():
+    """reserve_factor adds per-hour spinning-reserve rows (egret-style:
+    committed headroom >= r * demand, not satisfiable by shedding):
+    the all-on commitment keeps headroom and stays feasible, while an
+    under-committed hour that was rescued by load shed WITHOUT reserve
+    becomes infeasible WITH it."""
+    S = 6
+    br = uc.build_batch(S, H=6, reserve_factor=0.25)
+    b0 = uc.build_batch(S, H=6)
+    assert br.shared_A                     # reserve keeps the matmul path
+    assert br.num_rows == b0.num_rows + 6  # one row per hour
+    opts = {"defaultPHrho": 50.0, "PHIterLimit": 2, "convthresh": 0.0,
+            "pdhg_eps": 1e-6, "pdhg_max_iters": 100000}
+    phr = PH(opts, [f"s{i}" for i in range(S)], batch=br)
+    ph0 = PH(opts, [f"s{i}" for i in range(S)], batch=b0)
+    phr.Iter0()
+    ph0.Iter0()
+    all_on = uc.commitment_candidate(br, np.ones(br.num_nonants),
+                                     threshold=0.5)
+    vr, fr = phr.evaluate_xhat(all_on)
+    assert fr and np.isfinite(vr)
+    # all-off: shed covers energy without reserve, violates it with
+    all_off = np.zeros(br.num_nonants)
+    v0_off, f0_off = ph0.evaluate_xhat(all_off)
+    vr_off, fr_off = phr.evaluate_xhat(all_off)
+    assert f0_off                    # shed (penalty 1000/MWh) rescues
+    assert not fr_off                # reserve cannot be shed
+    # reserve binds the commitment: all-on objective >= no-reserve one
+    v0, _ = ph0.evaluate_xhat(all_on)
+    assert vr >= v0 - 1e-6 * (1 + abs(v0))
