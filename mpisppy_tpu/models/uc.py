@@ -73,13 +73,14 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
     reference's larger_uc study fit on one chip.
 
     reserve_factor r > 0 adds the egret-style spinning-reserve rows
-    (one per hour): committed headroom sum_g (Pmax_g u_gh - p_gh)
-    must cover r * demand_h.  Load shedding does NOT satisfy reserve
-    — an under-committed hour is infeasible, not merely expensive —
-    which is what makes reserve bind the commitment the way the
-    reference's egret UC reserves do.  The rows are
-    scenario-independent (demand-based requirement), so shared_A is
-    preserved."""
+    (one per hour), in capacity-adequacy form: committed capacity
+    sum_g Pmax_g u_gh must cover net load plus r * demand_h.  Neither
+    dispatch nor load shed appears in the row, so shedding cannot
+    satisfy reserve — an under-committed hour is infeasible, not
+    merely expensive — which is what makes reserve bind the
+    commitment the way the reference's egret UC reserves do.  Wind
+    enters the row bound per scenario (like the balance rows), so
+    shared_A is preserved."""
     if reserve_factor < 0:
         raise ValueError(
             f"reserve_factor must be >= 0, got {reserve_factor}")
@@ -190,15 +191,19 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
             A[:, r, uidx(g, tau)] = 1.0
             row_hi[:, r] = 1.0
         r += 1
-    # spinning reserve: sum_g (Pmax_g u_gh - p_gh) >= r * demand_h.
-    # No shed column — reserve is a commitment requirement, not an
-    # energy one
+    # spinning reserve, capacity-adequacy form: committed capacity
+    # sum_g Pmax_g u_gh >= net load + r * demand.  Neither p nor shed
+    # appears in the row — a headroom form (sum Pmax u - p >= R) leaks
+    # through shedding, because raising shed lets p drop and frees
+    # headroom one-for-one; the capacity form is what actually forces
+    # commitment.  Wind sits in the row BOUND, per scenario, exactly
+    # like the balance rows — shared_A is preserved.
     if n_res:
         for h in range(H):
             for g in range(G):
                 A[:, r, uidx(g, h)] = Pmax[g]
-                A[:, r, pidx(g, h)] = -1.0
-            row_lo[:, r] = reserve_factor * dem[h]
+            row_lo[:, r] = (dem[h] - wind[:, h]
+                            + reserve_factor * dem[h])
             r += 1
     assert r == M
 

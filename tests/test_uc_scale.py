@@ -269,11 +269,12 @@ def test_ef_dual_bound_validity():
 
 
 def test_uc_spinning_reserve_rows():
-    """reserve_factor adds per-hour spinning-reserve rows (egret-style:
-    committed headroom >= r * demand, not satisfiable by shedding):
-    the all-on commitment keeps headroom and stays feasible, while an
-    under-committed hour that was rescued by load shed WITHOUT reserve
-    becomes infeasible WITH it."""
+    """reserve_factor adds per-hour capacity-adequacy reserve rows
+    (egret-style: committed capacity >= net load + r * demand).
+    Neither dispatch nor shed appears in the row — a PARTIALLY
+    committed fleet whose energy balance is shed-rescuable must still
+    be reserve-infeasible (the leak a headroom-form constraint would
+    have: shedding frees dispatch headroom one-for-one)."""
     S = 6
     br = uc.build_batch(S, H=6, reserve_factor=0.25)
     b0 = uc.build_batch(S, H=6)
@@ -289,12 +290,18 @@ def test_uc_spinning_reserve_rows():
                                      threshold=0.5)
     vr, fr = phr.evaluate_xhat(all_on)
     assert fr and np.isfinite(vr)
-    # all-off: shed covers energy without reserve, violates it with
-    all_off = np.zeros(br.num_nonants)
-    v0_off, f0_off = ph0.evaluate_xhat(all_off)
-    vr_off, fr_off = phr.evaluate_xhat(all_off)
-    assert f0_off                    # shed (penalty 1000/MWh) rescues
-    assert not fr_off                # reserve cannot be shed
+    # peaker-only (Pmax 100 << net load + reserve): energy is
+    # shed-rescuable, capacity is not — the partial-commitment case
+    # that distinguishes the capacity form from the leaky headroom form
+    GH = br.num_nonants // 2
+    u = np.zeros(GH)
+    u[2 * 6: 3 * 6] = 1.0           # unit 2 = the peaker, all hours
+    peaker = uc.commitment_candidate(
+        br, np.concatenate([u, np.zeros(GH)]), threshold=0.5)
+    v0_p, f0_p = ph0.evaluate_xhat(peaker)
+    vr_p, fr_p = phr.evaluate_xhat(peaker)
+    assert f0_p                      # shed (penalty 1000/MWh) rescues
+    assert not fr_p                  # reserve cannot be shed
     # reserve binds the commitment: all-on objective >= no-reserve one
     v0, _ = ph0.evaluate_xhat(all_on)
     assert vr >= v0 - 1e-6 * (1 + abs(v0))
