@@ -357,7 +357,8 @@ def _derive_startups(batch, u):
 
 
 def one_opt_commitment(evaluator, batch, candidate, max_sweeps=4,
-                       flip_slots=None, chunk=64):
+                       flip_slots=None, chunk=64, screen_eps=None,
+                       screen_cap=None, verify_k=3):
     """Batched 1-opt local search on the commitment: each sweep
     evaluates single unit-hour flips of the incumbent commitment in
     stacked launches (up to `chunk` candidates x S scenarios each,
@@ -374,18 +375,55 @@ def one_opt_commitment(evaluator, batch, candidate, max_sweeps=4,
     chunk: flips per stacked launch.  A reference-scale fleet has
     GH ~ 500 slots; one (GH*S)-scenario stack of the (1536-var,
     2500-row) subproblem arrays would run to tens of GB, so sweeps
-    launch bounded chunks instead."""
+    launch bounded chunks instead.
+
+    screen_eps / screen_cap: when either is set, sweep launches run
+    as a cheap RANKING pass (loose tolerance, bounded PDHG
+    iterations) and flips are certified in screened rank order with
+    the accurate evaluator, keeping the first genuinely improving one
+    — the same two-stage screen/verify protocol as opt/mip.py's
+    refinement.  Per sweep at most `verify_k` ranks are certified
+    (3*verify_k on a would-be-terminating FULL sweep), so the
+    termination criterion under screening is "no flip among the top
+    3*verify_k screened ranks of a full sweep improves" — a bounded
+    relaxation of the exhaustive criterion, traded for ~10x cheaper
+    launches.  Screening also enables full/restricted sweep
+    alternation: a FULL sweep ranks every slot (len/chunk launches);
+    later sweeps re-rank only the top-`chunk` hot slots (1 launch),
+    and any stall triggers a full refresh, so only a full sweep can
+    terminate the search.  At reference scale (504 slots x 8 sweeps
+    x S=1000) full-accuracy sweeps are ~64 launches of a
+    64k-scenario stack; screening is what makes the full-slot search
+    affordable on one chip.  Without screen_*, behavior is the
+    original exhaustive protocol: every sweep scans all flip_slots
+    at full accuracy and only the argmin flip is certified."""
     cand = np.asarray(candidate, float).copy()
     GH = cand.size // 2
     if flip_slots is None:
         flip_slots = np.arange(GH)
     flip_slots = np.asarray(flip_slots, int)
+    screening = screen_eps is not None or screen_cap is not None
+    if screening and screen_eps is None:
+        # cap-only screening: a capped solve can't reach the
+        # full-accuracy tolerance, so derive a loose one from the
+        # evaluator's eps instead of screening everything infeasible
+        screen_eps = 10 * float(np.asarray(evaluator.solver_eps))
+    # a capped/loose screen can't reach the full-accuracy residual
+    # tolerance — widen the feasibility screen; certify restores rigor
+    screen_tol = 10 * float(screen_eps) if screening else None
+    # every launch is padded to one canonical candidate count, so the
+    # evaluator's one-live-stack cache and the jit shape survive
+    # across chunks, sweeps, and full/restricted alternation
+    kfix = min(chunk, len(flip_slots)) or 1
     val, feas = evaluator.evaluate_xhat(cand)
     if not feas:
         return cand, np.inf
+    hot_slots = None
     for _ in range(max_sweeps):
+        full = hot_slots is None
+        slots = flip_slots if full else hot_slots
         flips = []
-        for j in flip_slots:
+        for j in slots:
             u = cand[:GH].copy()
             u[j] = 1.0 - u[j]
             flips.append(np.concatenate([u, _derive_startups(batch, u)]))
@@ -393,27 +431,50 @@ def one_opt_commitment(evaluator, batch, candidate, max_sweeps=4,
             break
         objs = np.empty(len(flips))
         feas_m = np.zeros(len(flips), bool)
-        for lo in range(0, len(flips), chunk):
-            sl = slice(lo, min(lo + chunk, len(flips)))
+        for lo in range(0, len(flips), kfix):
+            sl = slice(lo, min(lo + kfix, len(flips)))
             block = flips[sl]
-            # pad a short remainder with the incumbent: every launch
-            # then has the SAME candidate count, so the evaluator's
-            # one-live-stack cache and the jit shape survive across
-            # chunks and sweeps
             k = len(block)
-            if len(flips) > chunk and k < chunk:
-                block = block + [cand] * (chunk - k)
-            o, f = evaluator.evaluate_candidates(np.stack(block))
+            if k < kfix:
+                block = block + [cand] * (kfix - k)
+            o, f = evaluator.evaluate_candidates(
+                np.stack(block), eps=screen_eps, iters_cap=screen_cap,
+                tol=screen_tol)
             objs[sl], feas_m[sl] = o[:k], f[:k]
         ok = np.flatnonzero(feas_m)
+        if full and screening and len(flip_slots) > chunk:
+            # hot set = best-ranked feasible slots; spuriously-
+            # infeasible ones (screen stragglers) fill the tail so
+            # restricted sweeps can still revisit them.  (When all
+            # slots fit one launch, a "restricted" sweep would be the
+            # same launch — stay in all-full mode.)
+            bad = np.setdiff1d(np.arange(len(flips)), ok)
+            order_all = np.concatenate([ok[np.argsort(objs[ok])], bad])
+            hot_slots = np.asarray(slots)[order_all[:chunk]]
         if ok.size == 0:
-            break
-        j = int(ok[np.argmin(objs[ok])])
-        # certify the winning flip with the accurate evaluator
-        v2, f2 = evaluator.evaluate_xhat(flips[j])
-        if not f2 or v2 >= val - 1e-7 * (1 + abs(val)):
-            break
-        cand, val = flips[j], v2
+            if full:
+                break
+            hot_slots = None
+            continue
+        # certify candidates in screened rank order with the accurate
+        # evaluator; keep the first genuine improvement.  A full sweep
+        # about to terminate digs deeper (3x) before giving up.
+        order = ok[np.argsort(objs[ok])]
+        if screening:
+            tries = order[:(3 * verify_k if full else verify_k)]
+        else:
+            tries = order[:1]
+        accepted = False
+        for j in map(int, tries):
+            v2, f2 = evaluator.evaluate_xhat(flips[j])
+            if f2 and v2 < val - 1e-7 * (1 + abs(val)):
+                cand, val = flips[j], v2
+                accepted = True
+                break
+        if not accepted:
+            if full:
+                break
+            hot_slots = None   # refresh with a full sweep next
     return cand, val
 
 

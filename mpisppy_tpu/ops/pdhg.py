@@ -409,7 +409,7 @@ class PDHGSolver:
 
     # -- impl --------------------------------------------------------
     def _solve_impl(self, prep, c, qdiag, lb, ub, obj_const, x0, y0,
-                    consensus=None, eps=None):
+                    consensus=None, eps=None, iters_cap=None):
         dc, dr = prep.d_col, prep.d_row
         # scale into solver space
         cs = c * dc
@@ -539,9 +539,19 @@ class PDHGSolver:
 
         ne = self.check_every
         n_outer = self.max_iters // ne
+        # traced SCREENING cap: callers ranking many speculative
+        # candidates (uc.one_opt_commitment sweeps, mip refine) bound
+        # the spend per launch without a second solver instance or a
+        # recompile per cap value
+        if iters_cap is None:
+            cap_outer = n_outer
+        else:
+            cap_outer = jnp.minimum(
+                jnp.asarray(n_outer, jnp.int32),
+                (jnp.asarray(iters_cap, jnp.int32) + ne - 1) // ne)
 
         def cond(carry):
-            return (carry.k < n_outer) & (~jnp.all(carry.converged))
+            return (carry.k < cap_outer) & (~jnp.all(carry.converged))
 
         def body(carry):
             x, y, xs, ys = steps(carry.x, carry.y, carry.omega, ne)

@@ -241,10 +241,18 @@ class PHBase(SPOpt):
         # certify="feas": refine (f64) only primal-infeasible scenarios
         # — matching the reference's infeasibility-only iter0 gate; a
         # solve legitimately riding to a big artificial box (epigraph
-        # variables pre-cuts) is dual-unconverged but NOT refined
-        res = self.solve_loop(lb=self.lb_eff, ub=self.ub_eff, warm=False,
-                              dtiming=self.options.get("display_timing"),
-                              certify="feas")
+        # variables pre-cuts) is dual-unconverged but NOT refined.
+        # options["iter0_certify"]=False skips the refine entirely —
+        # for batches that are feasible by construction (UC load shed)
+        # where an f32 stall is solver noise, a large straggler set
+        # would route through the CPU-f64 fallback and dominate
+        # accelerator wall-clock (the r4 UC-on-TPU timeout); Ebound's
+        # mask keeps the published bound valid either way
+        res = self.solve_loop(
+            lb=self.lb_eff, ub=self.ub_eff, warm=False,
+            dtiming=self.options.get("display_timing"),
+            certify=("feas" if self.options.get("iter0_certify", True)
+                     else False))
         feas = self.feas_prob(res)
         self.iter0_feas_mass = float(feas)   # benchmarks report this
         if feas < 1.0 - 1e-6:

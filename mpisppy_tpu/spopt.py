@@ -217,8 +217,16 @@ class SPOpt(SPBase):
             "y0": np.asarray(res.y, np.float64)[idx],
         }
         if self._solver64 is None:
+            # options["certify_max_iters"] bounds the f64 fallback's
+            # budget: on accelerators without f64 this path runs on the
+            # host CPU, and an uncapped 100k-iteration re-solve of a
+            # large straggler set can dominate wall-clock (r4 UC-on-TPU
+            # timeout); a capped certify still improves stragglers and
+            # the Ebound mask keeps unrescued ones out of the bound
             self._solver64 = PDHGSolver(
-                max_iters=max(self.solver.max_iters, 100000),
+                max_iters=int(self.options.get(
+                    "certify_max_iters",
+                    max(self.solver.max_iters, 100000))),
                 eps=self.solver.eps,
                 check_every=self.solver.check_every,
                 restart_every=self.solver.restart_every)
@@ -557,7 +565,8 @@ class SPOpt(SPBase):
         return eobj, feas
 
     def evaluate_candidates(self, candidates, tol=None,
-                            warm="xhat_candidates"):
+                            warm="xhat_candidates", eps=None,
+                            iters_cap=None, return_mass=False):
         """Evaluate k candidates in ONE stacked kernel launch:
         candidates (k, K) -> (Eobjs (k,), feas (k,)).
 
@@ -568,7 +577,13 @@ class SPOpt(SPBase):
         This is a SCREENING pass (no f64 certification on the stacked
         system): pres-based feasibility only.  Certify the winning
         candidate's bound with evaluate_xhat — calculate_incumbent
-        (utils/xhat_eval.py) does exactly that."""
+        (utils/xhat_eval.py) does exactly that.
+
+        eps / iters_cap: per-call solver tolerance and traced
+        iteration budget.  Rank-only callers (uc.one_opt_commitment
+        sweeps) pass a loose eps and a small cap so one launch costs a
+        fraction of a full-accuracy solve; pair with a looser `tol` so
+        a capped solve's residuals still count as feasible."""
         cands = np.asarray(candidates)
         k, K = cands.shape
         b = self.batch
@@ -609,7 +624,7 @@ class SPOpt(SPBase):
             }
             ftol = cache["ftol"]
 
-            def impl(vals_ks, x0, y0, eps):
+            def impl(vals_ks, x0, y0, eps, iters_cap=None):
                 # vals_ks: (k, K) -> (k*S, K)
                 vals2 = jnp.repeat(vals_ks, b.num_scens, axis=0).astype(
                     b.c.dtype)
@@ -623,7 +638,8 @@ class SPOpt(SPBase):
                                       axis=1))
                 res = self.solver._solve_impl(
                     prep2, stack["c_red"], stack["q_red"],
-                    stack["lb_red"], stack["ub_red"], oc, x0, y0, None, eps)
+                    stack["lb_red"], stack["ub_red"], oc, x0, y0, None,
+                    eps, iters_cap)
                 objs = jnp.sum(
                     (stack["prob"] * res.obj).reshape(k, b.num_scens),
                     axis=1)
@@ -636,8 +652,14 @@ class SPOpt(SPBase):
         if x0 is None or x0.shape[0] != k * b.num_scens:
             x0 = jnp.zeros_like(stack["c_red"])
             y0 = jnp.zeros_like(stack["row_lo"])
+        if eps is None:
+            eps = self.solver_eps
+        else:
+            eps = jnp.asarray(eps, b.c.dtype)
+        if iters_cap is not None:
+            iters_cap = jnp.asarray(iters_cap, jnp.int32)
         res, objs = stack["jit"](jnp.asarray(cands), x0, y0,
-                                 self.solver_eps)
+                                 eps, iters_cap)
         jax.block_until_ready(res.x)
         self._flops += _mfu.pdhg_flops(
             int(res.iters), k * b.num_scens, b.num_rows, b.num_vars,
@@ -648,6 +670,15 @@ class SPOpt(SPBase):
         ok = (np.asarray(res.pres) < tol).reshape(k, b.num_scens)
         live = np.asarray(b.prob) > 0
         feas = np.all(ok | ~live[None, :], axis=1)
+        if return_mass:
+            # per-candidate feasible probability mass — the diagnostic
+            # for "feasible for MOST scenarios but screened out":
+            # near-1 mass with feas=False means straggler solves, not
+            # an infeasible candidate
+            prob = np.asarray(b.prob)
+            mass = (ok * prob[None, :]).sum(axis=1) / max(prob.sum(),
+                                                          1e-12)
+            return np.asarray(objs), feas, mass
         return np.asarray(objs), feas
 
     # -- nonant fixing (reference spopt.py:592-740 _fix_nonants) ----------

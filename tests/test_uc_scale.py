@@ -168,6 +168,15 @@ def test_uc_one_opt_smoke():
                                       flip_slots=np.arange(6), chunk=2)
     assert v2 <= v0 + 1e-6
     assert abs(v1 - v2) <= 2e-2 * (1 + abs(v1))
+    # screen/verify mode (loose-eps capped ranking launches, accurate
+    # certify in rank order) obeys the same contract: every acceptance
+    # is gated by evaluate_xhat, so a bad screen can cost improvement
+    # but never a worse-than-start or unverified incumbent
+    cand3, v3 = uc.one_opt_commitment(ph, b, all_on, max_sweeps=2,
+                                      flip_slots=np.arange(6),
+                                      screen_eps=3e-3, screen_cap=500)
+    assert v3 <= v0 + 1e-6
+    assert abs(v1 - v3) <= 2e-2 * (1 + abs(v1))
 
 
 def test_uc_min_up_down_rows():
