@@ -86,3 +86,34 @@ def test_infeasible_detected():
                        jnp.zeros((1, 1)), jnp.ones((1, 1)))
     assert float(res.pres[0]) > 1e-3
     assert not bool(res.converged[0])
+
+
+def test_iters_cap_bounds_spend(farmer3):
+    """The traced screening cap (ops/pdhg._solve_impl iters_cap) stops
+    the solve after ~cap iterations when the uncapped solve needs
+    more, and different cap values reuse one trace (the cap is a
+    traced arg, so there is no recompile per budget value)."""
+    b = farmer3
+    prep = prepare_batch(b.A, b.row_lo, b.row_hi)
+    solver = PDHGSolver(max_iters=20000, eps=1e-11, check_every=40)
+    import jax.numpy as jnp
+    zargs = (jnp.zeros(b.c.shape[:1], b.c.dtype), jnp.zeros_like(b.c),
+             jnp.zeros_like(b.row_lo))
+    base = solver._solve_jit(prep, b.c, b.qdiag, b.lb, b.ub, *zargs,
+                             None, None, None)
+    n_base = int(base.iters)
+    if n_base < 200:
+        import pytest
+        pytest.skip("instance converges too fast to exercise the cap")
+    cap = max(80, n_base // 4)
+    capped = solver._solve_jit(prep, b.c, b.qdiag, b.lb, b.ub, *zargs,
+                               None, None, jnp.asarray(cap, jnp.int32))
+    assert int(capped.iters) <= cap + solver.check_every
+    assert int(capped.iters) < n_base
+    # different cap values must reuse the same trace
+    n_traces = solver._solve_jit._cache_size()
+    capped2 = solver._solve_jit(prep, b.c, b.qdiag, b.lb, b.ub, *zargs,
+                                None, None,
+                                jnp.asarray(2 * cap, jnp.int32))
+    assert solver._solve_jit._cache_size() == n_traces
+    assert int(capped2.iters) <= 2 * cap + solver.check_every

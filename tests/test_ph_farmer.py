@@ -120,3 +120,34 @@ def test_ph_sharded_multi_device():
     conv1, eobj1, triv1 = ph1.ph_main()
     assert abs(triv - triv1) < 1e-3 * abs(triv)
     assert abs(eobj - eobj1) < 1e-3 * abs(eobj)
+
+
+def test_iter0_certify_off_and_certify_budget(monkeypatch):
+    """options['iter0_certify']=False must keep Iter0 off the f64
+    straggler-rescue path entirely (the UC-on-TPU wall-clock guard),
+    and options['certify_max_iters'] must bound the f64 fallback
+    solver's budget."""
+    b = farmer.build_batch(3)
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 2, "convthresh": 0.0,
+             "pdhg_eps": 1e-7, "iter0_certify": False,
+             "certify_max_iters": 1234},
+            [f"s{i}" for i in range(3)], batch=b)
+    calls = []
+    orig = ph._certified_resolve
+
+    def spy(res, *a, **kw):
+        calls.append((a, kw))
+        return orig(res, *a, **kw)
+    monkeypatch.setattr(ph, "_certified_resolve", spy)
+    ph.Iter0()
+    assert calls == []          # no rescue attempted at Iter0
+    assert np.isfinite(ph.trivial_bound)
+    # force the refine path explicitly (a tiny LP can converge to
+    # machine-zero residuals, so an "unreachable eps" is not reliably
+    # a straggler); the lazily-built f64 solver must carry the budget
+    res = ph.solve_loop()
+    ph._certified_resolve(
+        res, None, None, None, None,
+        select=np.ones(ph.batch.num_scens, bool))
+    assert ph._solver64 is not None
+    assert ph._solver64.max_iters == 1234
