@@ -43,6 +43,45 @@ from ..scenario_tree import MultistageTree
 INF = float("inf")
 
 
+# IEEE 14-bus test case — standard public benchmark data (the
+# matpower/PGLib `case14`): bus loads (MW), branch endpoints and
+# series reactances (p.u.), generator buses, limits (MW), and
+# polynomial costs.  This is the kind of real network the reference
+# feeds egret (examples/acopf3/ccopf_multistage.py builds instances
+# from matpower case files); embedding the published case data mirrors
+# how sizes/sslp embed SIZES/SIPLIB instance data.  Branch thermal
+# limits: case14 publishes none (rateA=0 = unlimited); we use a
+# uniform finite `line_cap` (default 160 MW — non-binding in the
+# nominal dispatch, binding under outages) because the kernel's
+# bound-validity rule wants all-finite boxes.
+_IEEE14_LOAD = [0.0, 21.7, 94.2, 47.8, 7.6, 11.2, 0.0, 0.0, 29.5,
+                9.0, 3.5, 6.1, 13.5, 14.9]
+_IEEE14_LINES = [
+    (0, 1, 0.05917), (0, 4, 0.22304), (1, 2, 0.19797),
+    (1, 3, 0.17632), (1, 4, 0.17388), (2, 3, 0.17103),
+    (3, 4, 0.04211), (3, 6, 0.20912), (3, 8, 0.55618),
+    (4, 5, 0.25202), (5, 10, 0.19890), (5, 11, 0.25581),
+    (5, 12, 0.13027), (6, 7, 0.17615), (6, 8, 0.11001),
+    (8, 9, 0.08450), (8, 13, 0.27038), (9, 10, 0.19207),
+    (11, 12, 0.19988), (12, 13, 0.34802)]
+_IEEE14_GEN_BUS = [0, 1, 2, 5, 7]
+_IEEE14_GMAX = [332.4, 140.0, 100.0, 100.0, 100.0]
+_IEEE14_C1 = [20.0, 20.0, 40.0, 40.0, 40.0]
+_IEEE14_C2 = [0.0430292599, 0.25, 0.01, 0.01, 0.01]
+
+
+def _grid_ieee14(line_cap=160.0):
+    lines = [(a, b) for a, b, _ in _IEEE14_LINES]
+    # reactances are per-unit on the 100 MVA system base; loads/flows
+    # here are MW, so B[MW/rad] = 100 / x_pu
+    susceptance = np.array([100.0 / x for _, _, x in _IEEE14_LINES])
+    cap = np.full(len(lines), float(line_cap))
+    gen_bus = np.array(_IEEE14_GEN_BUS)
+    return (lines, susceptance, cap, gen_bus,
+            np.array(_IEEE14_GMAX), np.array(_IEEE14_C1),
+            np.array(_IEEE14_C2), np.array(_IEEE14_LOAD))
+
+
 def _grid(n_bus, n_line, n_gen, seed):
     rng = np.random.RandomState(seed)
     # ring + random chords; at most C(n_bus, 2) distinct lines exist,
@@ -65,14 +104,32 @@ def _grid(n_bus, n_line, n_gen, seed):
 
 
 def build_batch(branching_factors=(2, 2), n_bus=5, n_line=6, n_gen=3,
-                ramp=40.0, load_mismatch_cost=1000.0, seed=3301,
-                repair=False, dtype=np.float64) -> ScenarioBatch:
+                ramp=None, load_mismatch_cost=1000.0, seed=3301,
+                repair=False, case=None, line_cap=160.0,
+                dtype=np.float64) -> ScenarioBatch:
+    """case=None: seeded synthetic ring-plus-chords grid (n_bus /
+    n_line / n_gen sized).  case="ieee14": the embedded IEEE 14-bus
+    benchmark network (n_bus/n_line/n_gen ignored; `line_cap` sets the
+    uniform thermal limit).  ramp=None resolves per case: 40 MW on the
+    synthetic grid, a third of each unit's Pmax on ieee14."""
     tree = MultistageTree(list(branching_factors))
     T = tree.n_stages
     S = tree.num_scens
-    (lines, B, cap, gen_bus, gmax, c1, c2, base_load) = _grid(
-        n_bus, n_line, n_gen, seed)
+    if case == "ieee14":
+        (lines, B, cap, gen_bus, gmax, c1, c2, base_load) = \
+            _grid_ieee14(line_cap)
+        n_bus, n_gen = len(base_load), len(gen_bus)
+        if ramp is None:
+            ramp = gmax / 3.0
+    elif case is not None:
+        raise ValueError(f"unknown case {case!r} (None or 'ieee14')")
+    else:
+        (lines, B, cap, gen_bus, gmax, c1, c2, base_load) = _grid(
+            n_bus, n_line, n_gen, seed)
+        if ramp is None:
+            ramp = 40.0
     nL, nG, nB = len(lines), n_gen, n_bus
+    ramp_arr = np.broadcast_to(np.asarray(ramp, float), (nG,))
 
     # outage mask per scenario per stage: branch digit d at stage t>=2
     # fails line d-1 (0 = none); persists unless repair
@@ -141,8 +198,8 @@ def build_batch(branching_factors=(2, 2), n_bus=5, n_line=6, n_gen=3,
         for i in range(nG):
             A[:, r, vg(t, i)] = 1.0
             A[:, r, vg(t - 1, i)] = -1.0
-            row_lo[:, r] = -ramp
-            row_hi[:, r] = ramp
+            row_lo[:, r] = -ramp_arr[i]
+            row_hi[:, r] = ramp_arr[i]
             r += 1
     assert r == M
 
@@ -224,6 +281,12 @@ def inparser_adder(cfg):
                       default=6)
     cfg.add_to_config("n_gen", description="generators", domain=int,
                       default=3)
+    cfg.add_to_config("case", description="network case (ieee14 or "
+                      "empty for the synthetic grid)", domain=str,
+                      default="")
+    cfg.add_to_config("line_cap", description="uniform thermal limit "
+                      "(MW) for case networks", domain=float,
+                      default=160.0)
 
 
 def kw_creator(options):
@@ -232,7 +295,9 @@ def kw_creator(options):
         options.get("branching_factors", (2, 2))),
         "n_bus": options.get("n_bus", 5),
         "n_line": options.get("n_line", 6),
-        "n_gen": options.get("n_gen", 3)}
+        "n_gen": options.get("n_gen", 3),
+        "case": options.get("case") or None,
+        "line_cap": options.get("line_cap", 160.0)}
 
 
 def scenario_denouement(rank, scenario_name, result):

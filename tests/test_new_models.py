@@ -173,3 +173,35 @@ def test_aircond_xhat_generator():
         start_seed=7)
     assert xh.shape == (4,)                 # stage-1 nonants
     assert np.all(np.isfinite(xh))
+
+
+def test_acopf3_ieee14_case():
+    """case='ieee14' builds the embedded IEEE 14-bus benchmark network
+    (reference feeds egret matpower case files the same way,
+    examples/acopf3/ccopf_multistage.py): 14 buses, 20 lines, 5 gens,
+    259 MW total nominal load, and the nominal (no-outage) stage-1
+    dispatch matches the closed-form economic dispatch — marginal
+    costs equalize across the two cheap units with the expensive
+    40-$/MW block idle."""
+    b = acopf3.build_batch(branching_factors=(1,), case="ieee14")
+    nB, nL, nG = 14, 20, 5
+    per = nG + nB + nL + 2 * nB
+    assert b.num_vars == 2 * per          # T=2 stages
+    ef = ExtensiveForm(dict(OPTS), b.tree.scen_names, batch=b)
+    res = ef.solve_extensive_form()
+    assert bool(np.all(np.asarray(res.converged)))
+    x = np.asarray(res.x)[0]
+    g1 = x[:nG]
+    # no load shed in the nominal network
+    mp = x[nG + nB + nL:nG + nB + nL + nB]
+    mn = x[nG + nB + nL + nB:per]
+    assert np.abs(mp).max() < 1e-2 and np.abs(mn).max() < 1e-2
+    total = sum(acopf3._IEEE14_LOAD)
+    assert np.isclose(g1.sum(), total, atol=0.5)
+    # closed-form ED on the two 20-$/MW units (DC, caps non-binding):
+    # 2*c2_1*g1 = 2*c2_2*g2, g1+g2 = 259 ->
+    # g1 = total * c2_2/(c2_1+c2_2), marginal < 40 so g3..g5 = 0
+    c2a, c2b = acopf3._IEEE14_C2[0], acopf3._IEEE14_C2[1]
+    g1_star = total * c2b / (c2a + c2b)
+    assert np.isclose(g1[0], g1_star, rtol=2e-2), (g1, g1_star)
+    assert g1[2:].max() < 1.0
