@@ -89,6 +89,10 @@ MEDIUM = [
     ("aircond_cylinders.py",
      "--branching-factors 4,3,2 --max-iterations 10 --default-rho 1 "
      "--lagrangian --xhatshuffle"),
+    # real-network fidelity row: the embedded IEEE 14-bus case
+    ("acopf3_cylinders.py",
+     "--branching-factors 3,2,2 --max-iterations 10 --default-rho 5 "
+     "--case ieee14 --lagrangian --xhatshuffle"),
 ]
 
 
