@@ -383,6 +383,12 @@ def worker():
         # BENCH_SPLIT=1 opts in for A/B runs
         "no_split_prep": on_tpu and os.environ.get("BENCH_SPLIT") != "1",
     }
+    if int(os.environ.get("BENCH_LAG_CAP", 0) or 0) > 0:
+        # A/B knob: budget the Lagrangian bound solves (valid at any
+        # iterate for farmer's all-finite boxes — costs tightness
+        # only).  0/unset = uncapped.  Measured S=250 CPU: cheaper
+        # checks but +6 iterations — a wash; kept as a tuning lever.
+        opts["lagrangian_iters_cap"] = int(os.environ["BENCH_LAG_CAP"])
     ph = PH(opts, [f"scen{i}" for i in range(S)], batch=b)
 
     # warm up compiles (excluded: reference baseline excludes Gurobi

@@ -388,7 +388,7 @@ class PDHGSolver:
     def solve(self, prep: PreparedBatch, c, qdiag, lb, ub,
               obj_const=None, x0=None, y0=None,
               consensus: ConsensusSpec | None = None,
-              eps=None) -> SolveResult:
+              eps=None, iters_cap=None) -> SolveResult:
         """Solve the batch.  c/qdiag/lb/ub are UNSCALED user-space arrays
         (S, N); x0/y0 optional warm starts in user space.  With a
         ConsensusSpec, solves the monolithic EF (prep must come from
@@ -405,7 +405,7 @@ class PDHGSolver:
         if y0 is None:
             y0 = jnp.zeros((S, M), c.dtype)
         return self._solve_jit(prep, c, qdiag, lb, ub, obj_const, x0, y0,
-                               consensus, eps)
+                               consensus, eps, iters_cap)
 
     # -- impl --------------------------------------------------------
     def _solve_impl(self, prep, c, qdiag, lb, ub, obj_const, x0, y0,

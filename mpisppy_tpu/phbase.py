@@ -442,8 +442,17 @@ class PHBase(SPOpt):
             eps = self.options.get("lagrangian_eps")
         if eps is not None:
             eps = jnp.asarray(eps, b.c.dtype)
+        # optional per-solve budget ("lagrangian_iters_cap"): in the
+        # auto/LP case a CAPPED solve is still a valid bound (dual
+        # objective valid at any iterate) — it only costs tightness.
+        # The W-only objective has no prox term, so uncapped bound
+        # solves cost ~4x a PH iteration; a cap makes the bound-check
+        # cadence affordable.  Never applied when certify is on
+        # (capped+certified would mask most scenarios to -inf).
+        cap = None if certify else self.options.get(
+            "lagrangian_iters_cap")
         res = self.solve_loop(c=c_eff, warm="lagrangian", certify=certify,
-                              eps=eps)
+                              eps=eps, iters_cap=cap)
         return float(self.Ebound(res.dual_obj,
                                  converged=res.converged if certify
                                  else None))

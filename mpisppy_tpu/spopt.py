@@ -89,7 +89,8 @@ class SPOpt(SPBase):
 
     # -- hot path ---------------------------------------------------------
     def solve_loop(self, c=None, qdiag=None, lb=None, ub=None,
-                   warm=True, dtiming=False, certify=False, eps=None):
+                   warm=True, dtiming=False, certify=False, eps=None,
+                   iters_cap=None):
         """Solve every scenario subproblem (batched).  Any of
         c/qdiag/lb/ub override the batch's own arrays (this is how PH,
         Lagrangian and xhat objectives/fixings are expressed).
@@ -135,6 +136,7 @@ class SPOpt(SPBase):
             x0=cache[0],
             y0=cache[1],
             eps=self.solver_eps if eps is None else eps,
+            iters_cap=iters_cap,
         )
         self._flops += _mfu.pdhg_flops(
             int(res.iters), b.num_scens, b.num_rows, b.num_vars,
