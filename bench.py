@@ -3,11 +3,14 @@
 Reference comparator: the one hard number the reference repo contains is
 the 1000-scenario farmer EF solved by Gurobi 9.0 barrier in 2939.1 s
 (reference paperruns/scripts/farmer/ef_1000_1000.out; BASELINE.md).
-That run used crops_multiplier=1000; we solve the 1000-scenario farmer
-with crops_multiplier=10 via PH to a verified 1% outer/inner gap — a
-smaller per-scenario LP, so `vs_baseline` here is a protocol-level
-comparator (same model family, same scenario count, same gap target),
-not a like-for-like machine/size match.  The headline metric is
+That run is S=1000 at crops_multiplier=1000 — 11,998,000 rows x
+15,000,000 cols, ~12,000 rows x 15,000 vars PER SCENARIO.  Only a run
+at that size (the split-native ir.SplitA batch; dense would be ~288 GB)
+reports a nonzero `vs_baseline`.  Any smaller instance (the CPU
+fallback's crops_multiplier=10, or a reduced-S landing) is a DIFFERENT
+problem and reports under the `farmer_reduced_*` metric name with
+vs_baseline 0 — dividing a small-instance wall-clock by Gurobi's
+large-instance wall-clock is not a speedup.  The headline metric is
 wall-clock seconds to 1% verified gap.
 
 Bound validity (the round-2 failure was publishing polluted bounds):
@@ -33,8 +36,10 @@ HANG-PROOFING (the accelerator tunnel is single-client and wedges
 transiently — observed rounds 1-3; it can wedge BETWEEN a successful
 probe and the next backend init):
   * the top-level process never initializes jax at all;
-  * it probes the accelerator in fresh subprocesses, retrying across
-    several minutes (BENCH_PROBE_TRIES x BENCH_PROBE_WAIT);
+  * it probes the accelerator in fresh subprocesses, retrying every
+    BENCH_PROBE_WAIT seconds until BENCH_PROBE_DEADLINE (default 40%
+    of the TPU budget) — the r4 fixed-try window gave up on a
+    transient wedge the chip later recovered from;
   * the measured run itself executes in a subprocess under a hard
     timeout (BENCH_TPU_TIMEOUT); if that subprocess hangs or dies
     without printing the JSON line, the bench falls back to a CPU run
@@ -76,23 +81,29 @@ def _probe_once(timeout_s):
         return False
 
 
-def _fight_for_chip():
-    """Probe several times, spaced out: the tunnel wedges TRANSIENTLY
-    (round 2 got through; rounds 1/3 gave up after one probe).  Returns
-    (alive, attempts)."""
+def _fight_for_chip(deadline):
+    """Probe until `deadline` (time.time() value): the tunnel wedges
+    TRANSIENTLY (round 2 got through; rounds 1/3 gave up after one
+    probe; round 4's 4-try/8-min window also gave up while the tunnel
+    came back later).  The bench now fights for the chip for the whole
+    probe budget it has and falls back only at the deadline.
+    Returns (alive, attempts)."""
     if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
         return False, 0
-    tries = int(os.environ.get("BENCH_PROBE_TRIES", 4))
-    wait = float(os.environ.get("BENCH_PROBE_WAIT", 120))
+    wait = float(os.environ.get("BENCH_PROBE_WAIT", 60))
     timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
-    for attempt in range(1, tries + 1):
-        if _probe_once(timeout_s):
+    attempt = 0
+    while True:
+        attempt += 1
+        if _probe_once(min(timeout_s, max(deadline - time.time(), 5))):
             return True, attempt
-        print(f"[bench] accelerator probe {attempt}/{tries} failed",
+        remaining = deadline - time.time()
+        print(f"[bench] accelerator probe {attempt} failed "
+              f"({remaining:.0f}s of probe budget left)",
               file=sys.stderr)
-        if attempt < tries:
-            time.sleep(wait)
-    return False, tries
+        if remaining <= wait:
+            return False, attempt
+        time.sleep(wait)
 
 
 def _run_worker(extra_env, timeout_s):
@@ -343,6 +354,7 @@ def worker():
                                             ensure_cpu_backend)
     ensure_cpu_backend()
     import jax
+    import jax.numpy as jnp
 
     from mpisppy_tpu.models import farmer
     from mpisppy_tpu.opt.ph import PH
@@ -351,21 +363,25 @@ def worker():
     # landings where the parent didn't inject JAX_ENABLE_X64 (direct
     # --worker runs, plugin degradation)
     on_tpu = not enable_f64_if_cpu()
-    # FULL size by default on both backends: measured r4, the S=1000
-    # f64 CPU run closes the verified 1% gap in ~11 min (667 s timed,
-    # vs_baseline 4.41) — affordable, and it reports the REAL metric.
-    # The orchestrator retries a reduced size if this worker times out
-    # (flagged via BENCH_NOTE_FALLBACK so the annotation survives the
-    # explicit BENCH_SCENS it sets).
+    # On the accelerator the default is the TRUE baseline instance:
+    # S=1000 at crops_multiplier=1000 (11,998,000 rows x 15,000,000
+    # cols in the reference's EF formulation — the exact instance
+    # behind the 2939.1 s Gurobi number).  It exists only split-native
+    # (ir.SplitA; dense would be ~288 GB).  The CPU fallback defaults
+    # to crops_multiplier=10 — a ~10,000x smaller kernel workload that
+    # one host core can finish — and reports as farmer_reduced with
+    # vs_baseline 0 (flagged via BENCH_NOTE_FALLBACK when the
+    # orchestrator shrank it further).
     fallback_sized = not on_tpu and (
         "BENCH_SCENS" not in os.environ
         or os.environ.get("BENCH_NOTE_FALLBACK") == "1")
     S = int(os.environ.get("BENCH_SCENS", 1000))
-    mult = int(os.environ.get("BENCH_MULT", 10))
-    # the 2939.1 s Gurobi baseline is the S=1000, crops_multiplier=10
-    # protocol; any other size is a different instance and must not
-    # report under the baseline metric's name or ratio
-    at_baseline_size = (S == 1000 and mult == 10)
+    mult = int(os.environ.get("BENCH_MULT", 1000 if on_tpu else 10))
+    # the 2939.1 s Gurobi baseline is the S=1000 crops_multiplier=1000
+    # instance (reference paperruns/scripts/farmer/ef_1000_1000.out:10
+    # — 11,998,000 rows); any other size is a DIFFERENT instance and
+    # must not report under the baseline metric's name or ratio
+    at_baseline_size = (S == 1000 and mult == 1000)
 
     b = farmer.build_batch(S, crops_multiplier=mult,
                            dtype=np.float32 if on_tpu else np.float64)
@@ -392,11 +408,21 @@ def worker():
     ph = PH(opts, [f"scen{i}" for i in range(S)], batch=b)
 
     # warm up compiles (excluded: reference baseline excludes Gurobi
-    # license/startup too)
+    # license/startup too).  Warmup runs at a HUGE eps so every solve
+    # converges at its first KKT check: compile cost is identical (eps
+    # is a traced arg), kernel cost ~0 — at baseline size a
+    # full-accuracy warmup would cost as much as the timed run
+    warm_eps = 1e6
+    saved_eps = ph.solver_eps
+    saved_ss = ph._superstep_eps_opt
+    ph.solver_eps = jnp.asarray(warm_eps, b.c.dtype)
+    ph._superstep_eps_opt = warm_eps
     ph.Iter0()
     ph.ph_iteration()
     ph.evaluate_xhat(ph.root_xbar())
-    ph.lagrangian_bound()
+    ph.lagrangian_bound(eps=warm_eps)
+    ph.solver_eps = saved_eps
+    ph._superstep_eps_opt = saved_ss
 
     ph.clear_warmstart()
     ph.reset_solve_stats()
@@ -441,8 +467,17 @@ def worker():
     if fallback_sized:
         extra["note_size"] = ("accelerator unavailable: CPU fallback "
                               f"at S={S} (f64)")
-    metric = ("farmer1000_ph_seconds_to_1pct_gap" if at_baseline_size
-              else "farmer_reduced_ph_seconds_to_1pct_gap")
+    # the baseline-size metric name carries the instance (S x mult):
+    # only the 1000x1000 instance is the Gurobi comparator's problem.
+    # S=10000 x mult=100 is BASELINE.md's own farmer-10k target row
+    # (the scaledlw strong-scaling protocol shape at 10k scenarios);
+    # no reference wall-clock exists for it, so vs_baseline stays 0.
+    if at_baseline_size:
+        metric = "farmer1000x1000_ph_seconds_to_1pct_gap"
+    elif S == 10000 and mult == 100:
+        metric = "farmer10k_ph_seconds_to_1pct_gap"
+    else:
+        metric = "farmer_reduced_ph_seconds_to_1pct_gap"
     if gap > 0.01:
         print(json.dumps({
             "metric": metric,
@@ -463,11 +498,31 @@ def worker():
 
 
 def main():
-    alive, attempts = _fight_for_chip()
+    t_start = time.time()
+    tpu_budget = float(os.environ.get("BENCH_TPU_TIMEOUT", 2700))
+    deadline = t_start + tpu_budget
+    # probing may spend up to this fraction of the TPU budget before
+    # the bench concedes the chip (r4 gave up after ~8 min against a
+    # transient wedge; now it keeps fighting but still leaves the
+    # worker a majority share of the budget)
+    probe_deadline = t_start + float(os.environ.get(
+        "BENCH_PROBE_DEADLINE", 0.4 * tpu_budget))
+    alive, attempts = _fight_for_chip(probe_deadline)
     line = None
     if alive:
-        tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", 2700))
-        line = _run_worker({}, tpu_timeout)
+        model = os.environ.get("BENCH_MODEL", "farmer")
+        line = _run_worker({}, deadline - time.time())
+        if (line is None and model == "farmer"
+                and "BENCH_MULT" not in os.environ
+                and deadline - time.time() > 300):
+            # the true-size instance didn't finish in budget: retry
+            # REDUCED on the still-alive chip (honestly named — the
+            # worker reports farmer_reduced/vs_baseline 0 for it)
+            print("[bench] baseline-size run produced no result; "
+                  "retrying reduced size on accelerator",
+                  file=sys.stderr)
+            line = _run_worker({"BENCH_MULT": "10"},
+                               deadline - time.time())
         if line is None:
             print("[bench] accelerator run produced no result; "
                   "falling back to CPU", file=sys.stderr)

@@ -48,6 +48,11 @@ CORPUS = [
     ("uc_cylinders.py",
      "--num-scens 5 --max-iterations 20 --default-rho 1 "
      "--lagrangian --xhatshuffle"),
+    # the reference's REAL UC data (WECC-240, examples/uc/3scenarios_r1)
+    ("uc_wecc_cylinders.py",
+     "--num-scens 3 --uc-hours 6 --uc-max-units 20 "
+     "--max-iterations 10 --default-rho 50 "
+     "--lagrangian --xhatxbar"),
     ("aircond_cylinders.py",
      "--branching-factors 3,2 --max-iterations 30 --default-rho 1 "
      "--lagrangian --xhatshuffle"),

@@ -1,0 +1,22 @@
+"""uc_wecc_cylinders — the reference's ACTUAL UC instances (WECC-240
+data under reference examples/uc/<k>scenarios_r1/) through the
+cylinders stack (analog of the reference's examples/uc/uc_cylinders.py
+driving the same files through egret).
+
+    python examples/uc_wecc_cylinders.py --num-scens 3 \\
+        --uc-hours 6 --uc-max-units 20 --max-iterations 10 \\
+        --default-rho 50 --lagrangian --xhatxbar
+"""
+
+import sys
+
+from _driver import cylinders_main
+from mpisppy_tpu.models import uc_wecc
+
+
+def main(args=None):
+    return cylinders_main(uc_wecc, "uc_wecc_cylinders", args=args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -123,6 +123,37 @@ class XhatXbar(XhatBase):
         return xbar[None, :]
 
 
+class XhatLooper(XhatBase):
+    """Loop over the scenarios' own solutions as candidates (reference
+    extensions/xhatlooper.py: xhat_looper walks scenarios in order,
+    trying each scenario's nonant vector, up to scen_limit per pass).
+
+    TPU-native: one pass = ONE stacked evaluation of the next
+    `scen_limit` scenario solutions (spopt.evaluate_candidates), with
+    the walk position carried across calls so successive passes cover
+    the whole scenario set cyclically — the batched equivalent of the
+    reference's sequential first-feasible loop (its `_try_one` per
+    scenario becomes k rows of one kernel launch).
+
+    options: {"scen_limit": int (default 3), "cycle": int}.
+    """
+
+    char = "L"
+
+    def __init__(self, ph, options=None):
+        super().__init__(ph, options=options)
+        self._pos = 0
+
+    def candidates(self):
+        opt = self.opt
+        n = opt.n_real_scens
+        k = min(int(self.options.get("scen_limit", 3)), n)
+        x_na = np.asarray(opt.batch.nonants(opt.state.x))[:n]
+        idx = (self._pos + np.arange(k)) % n
+        self._pos = int((self._pos + k) % n)
+        return x_na[idx]
+
+
 class XhatSpecific(XhatBase):
     """Evaluate one named scenario's solution (reference
     extensions analog of cylinders/xhatspecific_bounder.py).

@@ -121,9 +121,26 @@ class ScenarioBatch:
         (1, M, N) and ops use ir.bmatvec's matmul fast path."""
         return self.A.shape[0] == 1 and self.c.shape[0] > 1
 
+    @property
+    def split_A(self):
+        """True when A is stored split-native (ir.SplitA: shared part +
+        per-scenario sparse delta) — the representation for instances
+        too large to ever materialize (S, M, N) densely (true-size
+        farmer: crops_multiplier=1000 is ~288 GB dense f32)."""
+        return isinstance(self.A, SplitA)
+
     def densify(self):
-        """Materialize a per-scenario A from a shared one (for code
-        paths that index A by scenario, e.g. the MIP dive)."""
+        """Materialize a per-scenario A from a shared or split one (for
+        code paths that index A by scenario, e.g. the MIP dive)."""
+        if self.split_A:
+            S, M, N = self.A.shape
+            if S * M * N > 500_000_000:
+                raise MemoryError(
+                    f"densify() of a split-native batch would build a "
+                    f"{S}x{M}x{N} tensor; this code path (dense "
+                    f"per-scenario A) does not support instances of "
+                    f"this size")
+            return dataclasses.replace(self, A=self.A.to_dense())
         if not self.shared_A:
             return self
         A = jnp.broadcast_to(self.A[0][None],
@@ -219,6 +236,31 @@ class SplitA:
 
 _register(SplitA, data_fields=("shared", "rows", "cols", "vals"),
           meta_fields=())
+
+
+class Static:
+    """Wrap a non-array value (string, tuple of names, ...) so it can
+    ride inside `model_meta` (a DATA pytree field): the wrapper
+    registers as a pytree node with NO array leaves — the value is
+    auxiliary data, invisible to tree_map / jit tracing / sharding."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def __repr__(self):
+        return f"Static({self.value!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Static) and self.value == other.value
+
+    def __hash__(self):
+        return hash(self.value)
+
+
+jax.tree_util.register_pytree_node(
+    Static, lambda s: ((), s.value), lambda aux, _: Static(aux))
 
 
 def delta_idx(batch):
@@ -392,10 +434,19 @@ def pad_scenarios(batch: ScenarioBatch, to: int) -> ScenarioBatch:
     # instead of its literal zero matrix, which only free rows (and
     # prob 0) make harmless — pad_scenarios must never emit pads with
     # finite row bounds.
+    if isinstance(batch.A, SplitA):
+        # a zero-padded scenario gets the SHARED matrix plus ZERO
+        # deltas — harmless under the free row bounds + prob 0 below
+        # (same argument as the shared-A case)
+        A_pad = SplitA(
+            shared=batch.A.shared, rows=batch.A.rows, cols=batch.A.cols,
+            vals=padfield(batch.A.vals))
+    else:
+        A_pad = batch.A if batch.shared_A else padfield(batch.A)
     return ScenarioBatch(
         c=padfield(batch.c),
         qdiag=padfield(batch.qdiag),
-        A=batch.A if batch.shared_A else padfield(batch.A),
+        A=A_pad,
         row_lo=padfield(batch.row_lo, -np.inf),
         row_hi=padfield(batch.row_hi, np.inf),
         lb=padfield(batch.lb),

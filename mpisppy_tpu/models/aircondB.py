@@ -1,0 +1,114 @@
+"""aircondB — the pickle-bundle variant of aircond (reference:
+mpisppy/tests/examples/aircondB.py — "PICKLE BUNDLE VERSION": proper
+bundles that consume entire second-stage subtrees are built once,
+dill-pickled to disk, and later runs unpickle them instead of
+rebuilding; aircondB.py:106-172).
+
+TPU-native: a proper bundle is one row of utils.bundles.bundle_batch's
+multistage bundling (in-bundle chain rows for stage>=2 nodes make each
+bundle a two-stage subproblem — the same construction as the
+reference's bundle EF), and pickling is the array-native npz
+round-trip (utils/pickle_bundle.py).  Per-bundle files follow the
+reference's "Bundle_first_last" naming (aircondB.py:146,171)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..utils import pickle_bundle
+from ..utils.bundles import bundle_batch
+from . import aircond
+
+MULTISTAGE = False   # bundled: two-stage by construction
+
+
+def bundle_names(num_scens, scenarios_per_bundle, start=0):
+    """Reference naming: Bundle_first_last over ORIGINAL scenario
+    numbers (aircondB.py:146)."""
+    m = int(scenarios_per_bundle)
+    return [f"Bundle_{i}_{i + m - 1}"
+            for i in range(start, start + num_scens, m)]
+
+
+def build_batch(branching_factors=(3, 2), scenarios_per_bundle=None,
+                pickle_bundles_dir=None, unpickle_bundles_dir=None,
+                start_seed=None, dtype=np.float64, **params):
+    """Bundled aircond batch.  scenarios_per_bundle defaults to one
+    full stage-2 subtree (prod of the non-root branching factors — the
+    smallest proper bundle).  pickle_bundles_dir: also write each
+    bundle as its own npz.  unpickle_bundles_dir: skip the model build
+    entirely and load the bundle files (the reference's split
+    write-then-solve workflow, aircondB.py:145-147)."""
+    bf = tuple(branching_factors)
+    m = int(scenarios_per_bundle or int(np.prod(bf[1:])) or 1)
+    S = int(np.prod(bf))
+    if unpickle_bundles_dir is not None:
+        from ..ir import stack_scenarios
+        names = bundle_names(S, m)
+        rows = [pickle_bundle.dill_unpickle(
+            os.path.join(unpickle_bundles_dir, nm)) for nm in names]
+        return stack_scenarios(rows, scen_names=[r.tree.scen_names[0]
+                                                 for r in rows])
+    base = aircond.build_batch(bf, start_seed=start_seed, dtype=dtype,
+                               **params)
+    bb = bundle_batch(base, m)
+    if pickle_bundles_dir is not None:
+        os.makedirs(pickle_bundles_dir, exist_ok=True)
+        for i, nm in enumerate(bundle_names(S, m)):
+            pickle_bundle.dill_pickle(
+                _slice_bundle(bb, i),
+                os.path.join(pickle_bundles_dir, nm))
+    return bb
+
+
+def _slice_bundle(bb, i):
+    """One bundle row as an S=1 ScenarioBatch (the per-file unit of the
+    reference's pickled-bundle directory)."""
+    import dataclasses
+
+    from ..ir import TreeInfo
+    sl = slice(i, i + 1)
+    tree = bb.tree
+    return dataclasses.replace(
+        bb,
+        c=bb.c[sl], qdiag=bb.qdiag[sl],
+        A=bb.A if bb.A.shape[0] == 1 else bb.A[sl],
+        row_lo=bb.row_lo[sl], row_hi=bb.row_hi[sl],
+        lb=bb.lb[sl], ub=bb.ub[sl], obj_const=bb.obj_const[sl],
+        integer_mask=bb.integer_mask[sl],
+        stage_cost_c=None,
+        tree=TreeInfo(
+            node_of=np.asarray(tree.node_of)[sl],
+            prob=np.asarray(tree.prob)[sl],
+            num_nodes=1,
+            stage_of=tree.stage_of,
+            nonant_names=tree.nonant_names,
+            scen_names=(tree.scen_names[i],)))
+
+
+def scenario_names_creator(num_scens, start=0, bundles_per_rank=None,
+                           scenarios_per_bundle=None):
+    """Names are BUNDLE names (the reference's aircondB
+    scenario_names_creator yields bundle names too)."""
+    m = int(scenarios_per_bundle or 1)
+    return bundle_names(num_scens, m, start=start)
+
+
+def inparser_adder(cfg):
+    aircond.inparser_adder(cfg)
+    pickle_bundle.pickle_bundle_parser(cfg)
+
+
+def kw_creator(options):
+    kw = aircond.kw_creator(options)
+    for key in ("pickle_bundles_dir", "unpickle_bundles_dir",
+                "scenarios_per_bundle"):
+        if options.get(key) is not None:
+            kw[key] = options[key]
+    return kw
+
+
+def scenario_denouement(rank, scenario_name, scenario):
+    pass

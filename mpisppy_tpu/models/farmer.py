@@ -64,15 +64,26 @@ def scenario_yields(scennum, crops_multiplier=1, seedoffset=0):
 
 
 def build_batch(num_scens, crops_multiplier=1, use_integer=False,
-                seedoffset=0, sense=1, dtype=np.float64) -> ScenarioBatch:
+                seedoffset=0, sense=1, dtype=np.float64,
+                split="auto") -> ScenarioBatch:
     """Vectorized batch builder: constructs all S scenarios' arrays at
     once (the host-side 'scenario_creator loop' collapsed — reference
     spbase.py:255-273 builds models one-by-one; here model build is a
-    numpy broadcast)."""
+    numpy broadcast).
+
+    split: store A split-native (ir.SplitA — one shared (M, N) matrix
+    plus the 2*nc per-scenario yield coefficients) instead of the dense
+    (S, M, N) tensor.  "auto" switches when the dense tensor would
+    exceed ~1 GB: the TRUE baseline-size instance (S=1000,
+    crops_multiplier=1000 — reference
+    paperruns/scripts/farmer/ef_1000_1000.out) is ~288 GB dense f32 and
+    only exists split-native."""
     nc = 3 * crops_multiplier
     N = 4 * nc
     M = 2 * nc + 1
     S = num_scens
+    if split == "auto":
+        split = S * M * N * np.dtype(dtype).itemsize > 1 << 30
 
     yields = np.stack([
         scenario_yields(i, crops_multiplier, seedoffset) for i in range(S)
@@ -83,25 +94,41 @@ def build_batch(num_scens, crops_multiplier=1, use_integer=False,
     isup = 2 * nc + iac
     ipur = 3 * nc + iac
 
-    A = np.zeros((S, M, N), dtype=dtype)
     row_lo = np.full((S, M), -INF, dtype=dtype)
     row_hi = np.full((S, M), INF, dtype=dtype)
-    # cattle feed: yield*x + purchased - sub - super >= req   (rows 0..nc)
     r = np.arange(nc)
-    A[:, r, iac] = yields
-    A[:, r, ipur] = 1.0
-    A[:, r, isub] = -1.0
-    A[:, r, isup] = -1.0
+    r2 = nc + r
+    # cattle feed: yield*x + purchased - sub - super >= req (rows 0..nc)
     row_lo[:, r] = np.tile(_CATTLE_REQ, crops_multiplier)
     # limit sold: sub + super - yield*x <= 0   (rows nc..2nc)
-    r2 = nc + r
-    A[:, r2, isub] = 1.0
-    A[:, r2, isup] = 1.0
-    A[:, r2, iac] = -yields
     row_hi[:, r2] = 0.0
     # total acreage  (last row)
-    A[:, -1, iac] = 1.0
     row_hi[:, -1] = 500.0 * crops_multiplier
+    delta_rows = np.concatenate([r, r2]).astype(np.int32)
+    delta_cols = np.concatenate([iac, iac]).astype(np.int32)
+    if split:
+        from ..ir import SplitA
+        shared = np.zeros((M, N), dtype=dtype)
+        shared[r, ipur] = 1.0
+        shared[r, isub] = -1.0
+        shared[r, isup] = -1.0
+        shared[r2, isub] = 1.0
+        shared[r2, isup] = 1.0
+        shared[-1, iac] = 1.0
+        # the yield slots (r x iac, r2 x iac) stay ZERO in shared; the
+        # per-scenario values live in vals at (delta_rows, delta_cols)
+        A = SplitA(shared=shared, rows=delta_rows, cols=delta_cols,
+                   vals=np.concatenate([yields, -yields], axis=1))
+    else:
+        A = np.zeros((S, M, N), dtype=dtype)
+        A[:, r, iac] = yields
+        A[:, r, ipur] = 1.0
+        A[:, r, isub] = -1.0
+        A[:, r, isup] = -1.0
+        A[:, r2, isub] = 1.0
+        A[:, r2, isup] = 1.0
+        A[:, r2, iac] = -yields
+        A[:, -1, iac] = 1.0
 
     lb = np.zeros((S, N), dtype=dtype)
     ub = np.full((S, N), INF, dtype=dtype)
@@ -160,10 +187,9 @@ def build_batch(num_scens, crops_multiplier=1, use_integer=False,
     )
     # the ONLY scenario-varying matrix entries are the 2*nc yield
     # coefficients (feed rows r x iac, limit-sold rows r2 x iac);
-    # declaring them lets SPOpt build the ir.SplitA fast path (shared
-    # matmul + nnz scatter instead of an (S, M, N) batched GEMV)
-    delta_rows = np.concatenate([r, r2]).astype(np.int32)
-    delta_cols = np.concatenate([iac, iac]).astype(np.int32)
+    # declaring them (model_meta below) lets SPOpt build the ir.SplitA
+    # fast path (shared matmul + nnz scatter instead of an (S, M, N)
+    # batched GEMV) even when A is stored dense
     return ScenarioBatch(
         c=c, qdiag=np.zeros((S, N), dtype=dtype),
         A=A, row_lo=row_lo, row_hi=row_hi, lb=lb, ub=ub,

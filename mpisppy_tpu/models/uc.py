@@ -419,7 +419,18 @@ def one_opt_commitment(evaluator, batch, candidate, max_sweeps=4,
     if not feas:
         return cand, np.inf
     hot_slots = None
-    for _ in range(max_sweeps):
+    # a failed RESTRICTED sweep schedules a full-sweep refresh; that
+    # refresh runs outside the max_sweeps budget, so the search always
+    # ends on a terminating full sweep (accept -> budget resumes;
+    # reject -> break), never on a stalled restricted sweep — the
+    # documented termination criterion even at small max_sweeps
+    sweeps_done = 0
+    pending_refresh = False
+    while sweeps_done < max_sweeps or pending_refresh:
+        if pending_refresh:
+            pending_refresh = False
+        else:
+            sweeps_done += 1
         full = hot_slots is None
         slots = flip_slots if full else hot_slots
         flips = []
@@ -455,6 +466,7 @@ def one_opt_commitment(evaluator, batch, candidate, max_sweeps=4,
             if full:
                 break
             hot_slots = None
+            pending_refresh = True
             continue
         # certify candidates in screened rank order with the accurate
         # evaluator; keep the first genuine improvement.  A full sweep
@@ -475,6 +487,7 @@ def one_opt_commitment(evaluator, batch, candidate, max_sweeps=4,
             if full:
                 break
             hot_slots = None   # refresh with a full sweep next
+            pending_refresh = True
     return cand, val
 
 

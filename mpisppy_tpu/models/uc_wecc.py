@@ -1,0 +1,453 @@
+"""uc_wecc — lowerer for the reference's ACTUAL stochastic UC data
+(reference: examples/uc/{3,5,10,25,50,100}scenarios_r1/ — the
+WECC-240 instances of Staid et al with scaled ISO-NE demand;
+examples/uc/uc_funcs.py loads them through egret's prescient dat
+parser and builds egret's tight UC MIP with UnitOn as the ONLY nonant,
+ScenarioStructure.dat StageVariables).
+
+This module parses the same .dat files directly (no Pyomo/egret) and
+lowers them into a shared-A ScenarioBatch: the scenario uncertainty is
+the hourly DEMAND (Node<k>.dat), which lives entirely in the balance /
+reserve ROW BOUNDS, so one (M, N) constraint matrix serves all
+scenarios (ir.ScenarioBatch.shared_A) and every batched matvec is a
+real matmul on the MXU.
+
+Formulation (3-bin LP/MIP, Rajan-Takriti + Carrion-Arroyo pieces):
+  vars  u,v,w in [0,1]^(G,H)  commitment / startup / shutdown
+        suc >= 0              startup-cost epigraph (per g,h)
+        p in [0, Pmax]        total generation
+        seg_{g,k,h}           piecewise production segments,
+                              0 <= seg <= width_gk
+        shed_h, over_h >= 0   load mismatch slacks (LoadMismatchPenalty)
+  rows  p <= Pmax u ; p >= Pmin u
+        p = point0_g * u + sum_k seg_k          (piecewise adapter)
+        sum_g p + shed - over = demand^s_h      (balance; per-scen rhs)
+        u_t - u_{t-1} = v_t - w_t               (3-bin logic; T0 rhs)
+        sum_{i in (t-UT, t]} v_i <= u_t         (min-up, RT form)
+        sum_{i in (t-DT, t]} w_i <= 1 - u_t     (min-down)
+        p_t - p_{t-1} <= RU u_{t-1} + SUramp v_t   (+ T0 row)
+        p_{t-1} - p_t <= RD u_t + SDramp w_t       (+ T0 row)
+        sum_g Pmax_g u_gh >= demand^s_h + R_h   (reserve, capacity form)
+        suc >= C_l (v_t - sum_{n<lag_l} w_{t-n} - hist)  (startup tiers)
+  cost  sum_gh [ suc + value0_g u + sum_k slope_gk seg ]
+        + pen * sum_h (shed + over)
+T0 conditions (UnitOnT0State / PowerGeneratedT0) enter as row bounds
+and as initial commitment fixings (a unit on for tau < UT hours stays
+on, off for tau < DT stays off — lb/ub on the first hours).
+
+Deliberate divergences from egret's tight model (documented, small):
+quick-start units earn no reserve credit while off (our reserve is
+committed-capacity only; R_h is ~2.5% of demand in these instances),
+and the piecewise production cost uses the instance's
+CostPiecewisePoints/Values verbatim (convex segments).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+from ..ir import ScenarioBatch, Static, TreeInfo
+
+INF = float("inf")
+# default instance lookup root; override for checkouts elsewhere
+REFERENCE_DIR = os.environ.get("MPISPPY_TPU_UC_DATA",
+                               "/root/reference/examples/uc")
+
+
+# --------------------------------------------------------------------------
+# .dat parsing (AMPL-format subset the instances use)
+# --------------------------------------------------------------------------
+
+def parse_root(path):
+    """Parse RootNode.dat -> dict of fleet/system parameters."""
+    txt = open(path).read()
+    out = {}
+    m = re.search(r"param NumTimePeriods := (\d+)", txt)
+    out["H"] = int(m.group(1))
+    m = re.search(r"param LoadMismatchPenalty := ([0-9.eE+-]+)", txt)
+    out["penalty"] = float(m.group(1)) if m else 1e6
+    gens = re.search(r"set ThermalGenerators := ([^;]+);", txt)
+    out["gens"] = gens.group(1).split()
+    qs = re.search(r"set QuickStartGenerators := ([^;]+);", txt)
+    out["quickstart"] = set(qs.group(1).split()) if qs else set()
+
+    tab = re.search(
+        r"param: PowerGeneratedT0 UnitOnT0State MinimumPowerOutput "
+        r"MaximumPowerOutput MinimumUpTime MinimumDownTime "
+        r"NominalRampUpLimit NominalRampDownLimit StartupRampLimit "
+        r"ShutdownRampLimit FuelCost :=\s*([^;]+);", txt)
+    rows = {}
+    for line in tab.group(1).strip().splitlines():
+        f = line.split()
+        rows[f[0]] = [float(x) for x in f[1:]]
+    out["table"] = rows
+
+    rr = re.search(r"param: ReserveRequirement :=\s*([^;]+);", txt)
+    res = np.zeros(out["H"])
+    if rr:
+        for line in rr.group(1).strip().splitlines():
+            h, v = line.split()
+            res[int(h) - 1] = float(v)
+    out["reserve"] = res
+
+    def curves(name):
+        d = {}
+        for g, v in re.findall(
+                rf"set {name}\[([^\]]+)\] := ([^;]*);", txt):
+            d[g] = [float(x) for x in v.split()]
+        return d
+
+    out["pw_points"] = curves("CostPiecewisePoints")
+    out["pw_values"] = curves("CostPiecewiseValues")
+    out["su_costs"] = curves("StartupCosts")
+    out["su_lags"] = curves("StartupLags")
+    return out
+
+
+def parse_demand(path, H):
+    txt = open(path).read()
+    m = re.search(r"param: Demand :=\s*([^;]+);", txt)
+    d = np.zeros(H)
+    for line in m.group(1).strip().splitlines():
+        _, h, v = line.split()
+        d[int(h) - 1] = float(v)
+    return d
+
+
+def available_instances(base_dir=REFERENCE_DIR):
+    out = {}
+    if not os.path.isdir(base_dir):
+        return out
+    for nm in os.listdir(base_dir):
+        m = re.match(r"(\d+)scenarios_r1$", nm)
+        if m:
+            out[int(m.group(1))] = os.path.join(base_dir, nm)
+    return out
+
+
+# --------------------------------------------------------------------------
+# lowering
+# --------------------------------------------------------------------------
+
+def build_batch(data_dir=None, num_scens=3, hours=None, max_units=None,
+                reserve=True, dtype=np.float64):
+    """Lower a reference UC instance directory into a shared-A batch.
+
+    data_dir: an instance dir (contains RootNode.dat + Node<k>.dat);
+    default picks the smallest reference instance with >= num_scens
+    scenarios.  hours / max_units truncate the horizon / fleet (the
+    full 85-unit 48 h system lowers to a ~6 GB f32 shared matrix —
+    TPU-sized; CPU test tiers trim).  Truncating hours also scales
+    each unit's min-up/down and startup lags down proportionally so
+    the shortened instance keeps binding commitment dynamics."""
+    if data_dir is None:
+        inst = available_instances()
+        cands = sorted(s for s in inst if s >= num_scens)
+        if not cands:
+            raise FileNotFoundError(
+                f"no reference UC instance with >= {num_scens} "
+                f"scenarios under {REFERENCE_DIR}")
+        data_dir = inst[cands[0]]
+    root = parse_root(os.path.join(data_dir, "RootNode.dat"))
+    H_full = root["H"]
+    H = int(hours or H_full)
+    scale = H / H_full
+    gens = root["gens"]
+    if max_units:
+        gens = gens[: int(max_units)]
+    G = len(gens)
+    S = int(num_scens)
+
+    demand = np.stack([
+        parse_demand(os.path.join(data_dir, f"Node{k + 1}.dat"),
+                     H_full)[:H]
+        for k in range(S)])                                  # (S, H)
+    if max_units:
+        # trim demand to the trimmed fleet's capacity scale so the
+        # instance stays feasible-without-shed at comparable margins
+        cap_full = sum(root["table"][g][3] for g in root["gens"])
+        cap_trim = sum(root["table"][g][3] for g in gens)
+        demand = demand * (cap_trim / cap_full)
+    reserve_req = root["reserve"][:H] if reserve else np.zeros(H)
+
+    tab = np.array([root["table"][g] for g in gens])
+    P0, T0, Pmin, Pmax = tab[:, 0], tab[:, 1], tab[:, 2], tab[:, 3]
+    UT = np.maximum(1, np.round(tab[:, 4] * scale)).astype(int)
+    DT = np.maximum(1, np.round(tab[:, 5] * scale)).astype(int)
+    RU, RD, SUr, SDr = tab[:, 6], tab[:, 7], tab[:, 8], tab[:, 9]
+    on0 = (T0 > 0).astype(float)
+    # remaining initial up/down obligation under the scaled windows
+    init_hold_on = np.maximum(
+        0, UT - np.round(np.maximum(T0, 0) * scale)).astype(int) \
+        * (T0 > 0)
+    init_hold_off = np.maximum(
+        0, DT - np.round(np.maximum(-T0, 0) * scale)).astype(int) \
+        * (T0 < 0)
+
+    pw_pts = [np.asarray(root["pw_points"].get(g, [Pmin[i], Pmax[i]]))
+              for i, g in enumerate(gens)]
+    pw_val = [np.asarray(root["pw_values"].get(g, [0.0, 0.0]))
+              for i, g in enumerate(gens)]
+    nseg = np.array([max(len(p) - 1, 0) for p in pw_pts])
+    seg_off = np.concatenate([[0], np.cumsum(nseg * H)])[:-1]
+    su_costs = [np.asarray(root["su_costs"].get(g, [0.0]))
+                for g in gens]
+    su_lags = [np.maximum(1, np.round(np.asarray(
+        root["su_lags"].get(g, [1])) * scale)).astype(int)
+        for g in gens]
+
+    # ---- layout ----------------------------------------------------------
+    GH = G * H
+    iu, iv, iw, isuc, ip = 0, GH, 2 * GH, 3 * GH, 4 * GH
+    iseg = 5 * GH
+    nsegtot = int((nseg * H).sum())
+    ish = iseg + nsegtot
+    iov = ish + H
+    N = iov + H
+
+    def uidx(g, h):
+        return iu + g * H + h
+
+    def vidx(g, h):
+        return iv + g * H + h
+
+    def widx(g, h):
+        return iw + g * H + h
+
+    def sucidx(g, h):
+        return isuc + g * H + h
+
+    def pidx(g, h):
+        return ip + g * H + h
+
+    def segidx(g, k, h):
+        return iseg + seg_off[g] + k * H + h
+
+    n_tier = int(sum(max(len(c) - 1, 0) for c in su_costs))
+    M = (3 * GH            # pmax, pmin, piecewise adapter
+         + H               # balance
+         + GH              # 3-bin logic
+         + 2 * GH          # min-up / min-down
+         + 2 * GH          # ramps (incl. T0 rows)
+         + (H if reserve else 0)
+         + GH              # startup tier 1
+         + n_tier * H)     # deeper startup tiers
+
+    A = np.zeros((1, M, N), dtype=dtype)
+    row_lo = np.full((S, M), -INF, dtype=dtype)
+    row_hi = np.full((S, M), INF, dtype=dtype)
+    r = 0
+    for g in range(G):
+        for h in range(H):
+            A[0, r, pidx(g, h)] = 1.0
+            A[0, r, uidx(g, h)] = -Pmax[g]
+            row_hi[:, r] = 0.0
+            r += 1
+    for g in range(G):
+        for h in range(H):
+            A[0, r, pidx(g, h)] = 1.0
+            A[0, r, uidx(g, h)] = -Pmin[g]
+            row_lo[:, r] = 0.0
+            r += 1
+    for g in range(G):           # p = point0 u + sum_k seg
+        for h in range(H):
+            A[0, r, pidx(g, h)] = 1.0
+            A[0, r, uidx(g, h)] = -pw_pts[g][0]
+            for k in range(nseg[g]):
+                A[0, r, segidx(g, k, h)] = -1.0
+            row_lo[:, r] = 0.0
+            row_hi[:, r] = 0.0
+            r += 1
+    for h in range(H):           # balance (per-scenario rhs)
+        for g in range(G):
+            A[0, r, pidx(g, h)] = 1.0
+        A[0, r, ish + h] = 1.0
+        A[0, r, iov + h] = -1.0
+        row_lo[:, r] = demand[:, h]
+        row_hi[:, r] = demand[:, h]
+        r += 1
+    for g in range(G):           # u_t - u_{t-1} - v_t + w_t = [T0]
+        for h in range(H):
+            A[0, r, uidx(g, h)] = 1.0
+            A[0, r, vidx(g, h)] = -1.0
+            A[0, r, widx(g, h)] = 1.0
+            if h > 0:
+                A[0, r, uidx(g, h - 1)] = -1.0
+                rhs = 0.0
+            else:
+                rhs = on0[g]
+            row_lo[:, r] = rhs
+            row_hi[:, r] = rhs
+            r += 1
+    for g in range(G):           # min-up (Rajan-Takriti)
+        for h in range(H):
+            for i in range(max(0, h - UT[g] + 1), h + 1):
+                A[0, r, vidx(g, i)] = 1.0
+            A[0, r, uidx(g, h)] = -1.0
+            row_hi[:, r] = 0.0
+            r += 1
+    for g in range(G):           # min-down
+        for h in range(H):
+            for i in range(max(0, h - DT[g] + 1), h + 1):
+                A[0, r, widx(g, i)] = 1.0
+            A[0, r, uidx(g, h)] = 1.0
+            row_hi[:, r] = 1.0
+            r += 1
+    for g in range(G):           # ramp up (h=0 row uses T0 power)
+        for h in range(H):
+            A[0, r, pidx(g, h)] = 1.0
+            A[0, r, vidx(g, h)] = -SUr[g]
+            if h > 0:
+                A[0, r, pidx(g, h - 1)] = -1.0
+                A[0, r, uidx(g, h - 1)] = -RU[g]
+                row_hi[:, r] = 0.0
+            else:
+                row_hi[:, r] = P0[g] + RU[g] * on0[g]
+            r += 1
+    for g in range(G):           # ramp down
+        for h in range(H):
+            A[0, r, pidx(g, h)] = -1.0
+            A[0, r, uidx(g, h)] = -RD[g]
+            A[0, r, widx(g, h)] = -SDr[g]
+            if h > 0:
+                A[0, r, pidx(g, h - 1)] = 1.0
+                row_hi[:, r] = 0.0
+            else:
+                row_hi[:, r] = -P0[g]
+            r += 1
+    if reserve:                  # committed capacity >= demand + R
+        for h in range(H):
+            for g in range(G):
+                A[0, r, uidx(g, h)] = Pmax[g]
+            row_lo[:, r] = demand[:, h] + reserve_req[h]
+            r += 1
+    for g in range(G):           # startup cost tier 1 (hottest)
+        c1 = su_costs[g][0]
+        for h in range(H):
+            A[0, r, sucidx(g, h)] = 1.0
+            A[0, r, vidx(g, h)] = -c1
+            row_lo[:, r] = 0.0
+            r += 1
+    for g in range(G):           # deeper tiers: suc >= C_l (v_t -
+        for li in range(1, len(su_costs[g])):   # recent shutdowns)
+            cl = su_costs[g][li]
+            lag = int(su_lags[g][li])
+            for h in range(H):
+                A[0, r, sucidx(g, h)] = 1.0
+                A[0, r, vidx(g, h)] = -cl
+                hist = 0.0
+                for n in range(1, lag):
+                    if h - n >= 0:
+                        A[0, r, widx(g, h - n)] = cl
+                    elif T0[g] < 0 and (n - h) == round(
+                            -T0[g] * scale) + 1:
+                        hist += cl   # pre-horizon shutdown credit
+                row_lo[:, r] = -hist
+                r += 1
+    assert r == M, (r, M)
+
+    lb = np.zeros((S, N), dtype=dtype)
+    ub = np.full((S, N), INF, dtype=dtype)
+    ub[:, iu:ip] = 1.0                  # u, v, w boxes
+    # suc is bounded by the coldest startup cost (implied; keeps every
+    # box finite so the dual objective is a valid Lagrangian bound at
+    # any iterate — spopt.valid_Ebound)
+    for g in range(G):
+        ub[:, sucidx(g, 0):sucidx(g, 0) + H] = float(su_costs[g][-1]) \
+            + 1.0
+        ub[:, pidx(g, 0):pidx(g, 0) + H] = Pmax[g]
+        for k in range(nseg[g]):
+            ub[:, segidx(g, k, 0):segidx(g, k, 0) + H] = (
+                pw_pts[g][k + 1] - pw_pts[g][k])
+    dmax = float(demand.max())
+    ub[:, ish:] = 2.0 * dmax
+    # initial commitment obligations from T0 state
+    for g in range(G):
+        for h in range(int(init_hold_on[g])):
+            lb[:, uidx(g, h)] = 1.0
+        for h in range(int(init_hold_off[g])):
+            ub[:, uidx(g, h)] = 0.0
+
+    c = np.zeros((S, N), dtype=dtype)
+    c[:, isuc:ip] = 1.0                 # epigraph carries startup cost
+    for g in range(G):
+        c[:, uidx(g, 0):uidx(g, 0) + H] = pw_val[g][0]
+        for k in range(nseg[g]):
+            width = pw_pts[g][k + 1] - pw_pts[g][k]
+            slope = ((pw_val[g][k + 1] - pw_val[g][k]) / width
+                     if width > 0 else 0.0)
+            c[:, segidx(g, k, 0):segidx(g, k, 0) + H] = slope
+    c[:, ish:] = root["penalty"]
+
+    integer_mask = np.zeros((S, N), dtype=bool)
+    integer_mask[:, iu:ip] = True       # u, v, w
+
+    nonant_idx = np.arange(iu, iu + GH, dtype=np.int32)   # UnitOn only
+    var_names = (
+        tuple(f"UnitOn[{g},{h}]" for g in gens for h in range(H))
+        + tuple(f"UnitStart[{g},{h}]" for g in gens for h in range(H))
+        + tuple(f"UnitStop[{g},{h}]" for g in gens for h in range(H))
+        + tuple(f"StartupCost[{g},{h}]" for g in gens for h in range(H))
+        + tuple(f"PowerGenerated[{g},{h}]" for g in gens
+                for h in range(H))
+        + tuple(f"seg{i}" for i in range(nsegtot))
+        + tuple(f"LoadShed[{h}]" for h in range(H))
+        + tuple(f"OverGen[{h}]" for h in range(H)))
+    tree = TreeInfo(
+        node_of=np.zeros((S, GH), np.int32),
+        prob=np.full((S,), 1.0 / S, dtype=dtype),
+        num_nodes=1,
+        stage_of=(1,) * GH,
+        nonant_names=var_names[:GH],
+        scen_names=tuple(f"Scenario{k + 1}" for k in range(S)),
+    )
+    return ScenarioBatch(
+        c=c, qdiag=np.zeros((S, N), dtype=dtype),
+        A=A, row_lo=row_lo, row_hi=row_hi, lb=lb, ub=ub,
+        obj_const=np.zeros((S,), dtype=dtype),
+        nonant_idx=nonant_idx,
+        integer_mask=integer_mask,
+        tree=tree,
+        var_names=var_names,
+        model_meta={"G": G, "H": H,
+                    "gens": Static(tuple(gens)),
+                    "data_dir": Static(data_dir)},
+    )
+
+
+# ---- amalgamator-contract helpers ----------------------------------------
+
+def scenario_names_creator(num_scens, start=0):
+    return [f"Scenario{i + 1}" for i in range(start, start + num_scens)]
+
+
+def inparser_adder(cfg):
+    cfg.num_scens_required()
+    cfg.add_to_config("uc_data_dir",
+                      description="reference UC instance directory",
+                      domain=str, default=None)
+    cfg.add_to_config("uc_hours", description="truncate horizon",
+                      domain=int, default=None)
+    cfg.add_to_config("uc_max_units", description="truncate fleet",
+                      domain=int, default=None)
+
+
+def kw_creator(options):
+    return {"data_dir": options.get("uc_data_dir"),
+            "num_scens": options.get("num_scens"),
+            "hours": options.get("uc_hours"),
+            "max_units": options.get("uc_max_units")}
+
+
+def batch_creator(cfg_or_kwargs, num_scens=None):
+    kw = dict(cfg_or_kwargs)
+    n = num_scens or kw.pop("num_scens", None)
+    kw.pop("num_scens", None)
+    return build_batch(num_scens=n, **kw)
+
+
+def scenario_denouement(rank, scenario_name, scenario):
+    pass

@@ -204,11 +204,29 @@ def prepare_batch_split(A, rows, cols, row_lo, row_hi, ruiz_iters=10):
     Returns a PreparedBatch whose A is a SplitA and whose d_row/d_col
     are (1, M)/(1, N) — the shared-A broadcasting convention.
     """
-    S, M, N = A.shape
     rows = jnp.asarray(rows, jnp.int32)
     cols = jnp.asarray(cols, jnp.int32)
     vals = A[:, rows, cols]                          # (S, nnz)
     A0 = A[0].at[rows, cols].set(0.0)                # (M, N) shared part
+    return _prepare_split_core(A0, rows, cols, vals, row_lo, row_hi,
+                               ruiz_iters=ruiz_iters)
+
+
+def prepare_split_native(A: "SplitA", row_lo, row_hi, ruiz_iters=10):
+    """prepare_batch_split for a batch born split (ir.ScenarioBatch.A
+    IS a SplitA — the only representation at sizes where the dense
+    (S, M, N) tensor cannot exist, e.g. true-size farmer)."""
+    return _prepare_split_core(
+        A.shared, jnp.asarray(A.rows, jnp.int32),
+        jnp.asarray(A.cols, jnp.int32), A.vals, row_lo, row_hi,
+        ruiz_iters=ruiz_iters)
+
+
+@partial(jax.jit, static_argnames=("ruiz_iters",))
+def _prepare_split_core(A0, rows, cols, vals, row_lo, row_hi,
+                        ruiz_iters=10):
+    M, N = A0.shape
+    A0 = A0.at[rows, cols].set(0.0)   # enforce the zeros-at-delta contract
     eps = 1e-12
 
     def body(_, carry):
@@ -226,7 +244,7 @@ def prepare_batch_split(A, rows, cols, row_lo, row_hi, ruiz_iters=10):
 
     A0s, vs, dr, dc = lax.fori_loop(
         0, ruiz_iters, body,
-        (A0, vals, jnp.ones((M,), A.dtype), jnp.ones((N,), A.dtype)))
+        (A0, vals, jnp.ones((M,), A0.dtype), jnp.ones((N,), A0.dtype)))
     As = SplitA(shared=A0s, rows=rows, cols=cols, vals=vs)
     anorm = _power_iteration(As)
     d_row = dr[None, :]

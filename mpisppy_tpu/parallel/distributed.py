@@ -1,0 +1,67 @@
+"""Multi-host initialization — the DCN story of SURVEY.md §2.3 made
+real code.
+
+The reference scales across hosts by launching one MPI rank per
+cylinder-shard and splitting COMM_WORLD (reference
+spin_the_wheel.py:219-237 _make_comms); inter-host traffic is MPI over
+the cluster fabric.  Here each HOST PROCESS calls `init_multihost()`
+once; jax.distributed wires the processes into one runtime, after
+which `jax.devices()` returns the GLOBAL device list, a ScenarioMesh
+over it spans every process, and the very same consensus program
+(segment-sum + psum under GSPMD) lowers its reductions to
+cross-process collectives — ICI within a slice, DCN across slices.
+No algorithm code changes between 1 device, 1 host x N devices, and
+M hosts x N devices; that is the point of the design.
+
+On TPU pods every argument is auto-detected from the environment.  On
+CPU/GPU fleets (and the 2-process CPU test tier,
+tests/test_multihost.py) pass coordinator/num/id explicitly or via
+MPISPPY_TPU_COORDINATOR / MPISPPY_TPU_NUM_PROCS /
+MPISPPY_TPU_PROC_ID.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .mesh import ScenarioMesh
+
+
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None):
+    """Join this process into the global JAX runtime
+    (jax.distributed.initialize).  Idempotent: a second call is a
+    no-op so library code may call it defensively.  Must run BEFORE
+    any backend-initializing JAX call (jax.devices etc.) — so the
+    idempotence check keeps to our own flag, never jax.process_count()
+    (which would itself initialize the backend)."""
+    if getattr(init_multihost, "_done", False):
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "MPISPPY_TPU_COORDINATOR")
+    if num_processes is None and "MPISPPY_TPU_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["MPISPPY_TPU_NUM_PROCS"])
+    if process_id is None and "MPISPPY_TPU_PROC_ID" in os.environ:
+        process_id = int(os.environ["MPISPPY_TPU_PROC_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    init_multihost._done = True
+
+
+def global_mesh(axis_name="scen"):
+    """ScenarioMesh over the GLOBAL device list (call after
+    init_multihost)."""
+    return ScenarioMesh(devices=jax.devices(), axis_name=axis_name)
+
+
+def process_index():
+    return jax.process_index()
+
+
+def is_coordinator():
+    """Analog of the reference's rank-0 gating (global_rank == 0)."""
+    return jax.process_index() == 0

@@ -8,7 +8,11 @@ are placed with a NamedSharding over axis "scen", every consensus
 reduction is a sum over that axis inside one jit-compiled program, and
 XLA lowers the reductions to ICI collectives (psum / reduce-scatter)
 automatically under GSPMD.  Multi-host DCN scaling follows the same
-code path — jax.distributed initializes the global mesh.
+code path — parallel.distributed.init_multihost wires the processes
+into one runtime (jax.distributed), after which this mesh spans the
+GLOBAL device list and the same program's collectives cross process
+boundaries (exercised by tests/test_multihost.py on a 2-process CPU
+fleet).
 
 The n_devices=1 case IS the serial mock (reference mpisppy/MPI.py:19-82
 _MockMPIComm): the same program compiles to a single-device executable
@@ -28,7 +32,15 @@ from ..ir import ScenarioBatch, pad_scenarios
 class ScenarioMesh:
     """A 1-D (or 2-D cylinder x scenario) device mesh for scenario
     parallelism — the analog of the reference's rank grid
-    (spin_the_wheel.py:219-237 _make_comms)."""
+    (spin_the_wheel.py:219-237 _make_comms).
+
+    Multi-host: after parallel.distributed.init_multihost(),
+    jax.devices() returns the GLOBAL device list and this same mesh
+    spans every process — placement then goes through
+    jax.make_array_from_callback (each process materializes only its
+    addressable shards) and XLA lowers the consensus reductions to
+    cross-process collectives over DCN, the analog of the reference's
+    inter-node MPI traffic (SURVEY.md §2.3)."""
 
     def __init__(self, devices=None, axis_name="scen"):
         if devices is None:
@@ -36,6 +48,15 @@ class ScenarioMesh:
         self.devices = list(devices)
         self.axis_name = axis_name
         self.mesh = Mesh(np.array(self.devices), (axis_name,))
+        # single-process fast path keeps plain device_put
+        self.multihost = jax.process_count() > 1
+
+    def _put(self, arr, sharding):
+        if not self.multihost:
+            return jax.device_put(arr, sharding)
+        host = np.asarray(arr)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
 
     @property
     def size(self):
@@ -75,22 +96,27 @@ class ScenarioMesh:
                 # shared constraint matrix (ir.ScenarioBatch.shared_A):
                 # replicated, not sharded — every device multiplies its
                 # scenario shard against the same (M, N) matrix
-                return jax.device_put(arr, repl)
+                return self._put(arr, repl)
+            if name == "vals":
+                # SplitA per-scenario delta values, (S, nnz): the only
+                # scenario-leading leaf inside a split-native A (its
+                # shared/rows/cols are replicated metadata below)
+                return self._put(arr, shard)
             if name in scen_leading:
-                return jax.device_put(arr, shard)
+                return self._put(arr, shard)
             if name == "stage_cost_c":  # (n_stages, S, N)
-                return jax.device_put(
+                return self._put(
                     arr, NamedSharding(self.mesh, P(None, self.axis_name)))
-            return jax.device_put(arr, repl)
+            return self._put(arr, repl)
 
         return jax.tree_util.tree_map_with_path(place, batch)
 
     def shard_like_batch(self, arr):
         """Place an (S, ...) array with the batch sharding."""
-        return jax.device_put(jax.numpy.asarray(arr), self.batch_sharding())
+        return self._put(np.asarray(arr), self.batch_sharding())
 
     def replicate(self, arr):
-        return jax.device_put(jax.numpy.asarray(arr), self.replicated())
+        return self._put(np.asarray(arr), self.replicated())
 
 
 def local_mesh():

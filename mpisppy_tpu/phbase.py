@@ -260,8 +260,18 @@ class PHBase(SPOpt):
             # "quitting after iter 0 because of infeasibility");
             # set options["iter0_infeasibility_ok"] to downgrade to a
             # warning (and accept -inf bounds from Ebound's mask)
-            msg = (f"iter0 feasible mass only {feas} after certified "
-                   f"re-solve: infeasible or unsolvable scenario(s)")
+            if self.options.get("iter0_certify", True):
+                msg = (f"iter0 feasible mass only {feas} after "
+                       f"certified re-solve: infeasible or unsolvable "
+                       f"scenario(s)")
+            else:
+                # no certification ran — an f32 stall is
+                # indistinguishable from true infeasibility here
+                msg = (f"iter0 feasible mass only {feas} on the "
+                       f"UNCERTIFIED fast solve (iter0_certify=False): "
+                       f"enable iter0_certify for an f64 re-solve, or "
+                       f"set iter0_infeasibility_ok to continue with "
+                       f"masked bounds")
             if self.options.get("iter0_infeasibility_ok", False):
                 global_toc("WARNING: " + msg)
             else:
@@ -284,8 +294,13 @@ class PHBase(SPOpt):
 
     # -- one PH iteration, fully fused ------------------------------------
     def _superstep_impl(self, state: PHState, rho, W_on, prox_on,
-                        lb=None, ub=None, eps=None, prep=None):
-        b = self.batch
+                        lb=None, ub=None, eps=None, prep=None,
+                        batch=None):
+        # batch as a traced ARG (not a closure constant): multihost
+        # meshes forbid closing over arrays that span non-addressable
+        # devices, and passing it also lets bound-rewriting extensions
+        # swap batches without recompiling
+        b = self.batch if batch is None else batch
         lb = b.lb if lb is None else lb
         ub = b.ub if ub is None else ub
         # prep as a traced ARG (not a closure constant): extensions
@@ -322,7 +337,8 @@ class PHBase(SPOpt):
         t0 = time.time()
         self.state = self._superstep(
             self.state, self.rho, self.W_on, self.prox_on,
-            self.lb_eff, self.ub_eff, self.superstep_eps, self.prep)
+            self.lb_eff, self.ub_eff, self.superstep_eps, self.prep,
+            self.batch)
         # account the superstep's kernel work (utils/mfu): iters ride
         # along in the state so no extra device sync is needed beyond
         # the conv readback below

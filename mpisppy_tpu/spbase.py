@@ -59,8 +59,9 @@ class SPBase:
                 for name in self.all_scenario_names
             ]
             batch = stack_scenarios(scens, scen_names=self.all_scenario_names)
-        if self._needs_dense_A and batch.shared_A:
-            batch = batch.densify()
+        if self._needs_dense_A and (batch.shared_A or batch.split_A):
+            batch = batch.densify()   # raises MemoryError at sizes
+            # where a dense per-scenario A cannot exist (split-native)
         self.n_real_scens = len(self.all_scenario_names)
         if variable_probability is not None:
             # per-(scenario, nonant-slot) averaging weights (reference

@@ -108,11 +108,29 @@ class WheelSpinner:
                 t.start()
             hub.main()
             hub.send_terminate()
-            # unbounded join: spokes exit after their current step (a
-            # bounded batched solve); finalizing while a spoke thread
-            # still runs would race on its opt's warm-start caches
-            for t in threads:
-                t.join()
+            # BOUNDED join: a healthy spoke exits after its current
+            # step (a bounded batched solve), but a spoke stuck in a
+            # pathological solve must not block shutdown forever (the
+            # reference's kill protocol always terminates,
+            # spin_the_wheel.py:119-144).  A thread still alive at the
+            # deadline is escalated through the same failure-pruning
+            # path a crashed spoke takes: marked failed so finalize
+            # skips it (its state is suspect, and finalizing a
+            # still-running spoke would race its warm-start caches);
+            # the daemon thread dies with the process.
+            join_timeout = float((hub.options or {}).get(
+                "shutdown_join_timeout", 120.0))
+            # PER-THREAD budget (worst case n_spokes * timeout, still
+            # bounded): one hung spoke must not eat the others'
+            # join time — a healthy spoke finishing a long step would
+            # then be falsely escalated and its results discarded
+            for t, sp in zip(threads, spokes):
+                t.join(timeout=join_timeout)
+                if t.is_alive():
+                    hub.report_spoke_failure(sp, TimeoutError(
+                        f"spoke did not exit within {join_timeout:.0f}s "
+                        "of the kill signal"))
+            hub._drain_failures()
         else:
             hub.drive_spokes_inline = True
             hub.main()

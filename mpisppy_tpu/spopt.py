@@ -22,8 +22,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import global_toc
-from .ir import bmatvec, delta_idx
-from .ops.pdhg import PDHGSolver, prepare_batch, prepare_batch_split
+from .ir import SplitA, bmatvec, delta_idx
+from .ops.pdhg import (PDHGSolver, PreparedBatch, prepare_batch,
+                       prepare_batch_split, prepare_split_native)
 from .spbase import SPBase
 from .utils import mfu as _mfu
 
@@ -56,7 +57,12 @@ class SPOpt(SPBase):
         else:
             global_toc("Preparing batch (Ruiz scaling + ||A|| estimate)")
             delta = delta_idx(self.batch)
-            if (delta is not None and self._use_split_prep
+            if self.batch.split_A:
+                # batch born split-native (no dense A exists, true-size
+                # instances): the split prep is the ONLY prep
+                self.prep = prepare_split_native(
+                    self.batch.A, self.batch.row_lo, self.batch.row_hi)
+            elif (delta is not None and self._use_split_prep
                     and not self.batch.shared_A
                     and not o.get("no_split_prep")):
                 # sparse matrix uncertainty (ir.SplitA): shared-scaling
@@ -218,17 +224,20 @@ class SPOpt(SPBase):
             "x0": np.asarray(res.x, np.float64)[idx],
             "y0": np.asarray(res.y, np.float64)[idx],
         }
-        if self._solver64 is None:
-            # options["certify_max_iters"] bounds the f64 fallback's
-            # budget: on accelerators without f64 this path runs on the
-            # host CPU, and an uncapped 100k-iteration re-solve of a
-            # large straggler set can dominate wall-clock (r4 UC-on-TPU
-            # timeout); a capped certify still improves stragglers and
-            # the Ebound mask keeps unrescued ones out of the bound
+        # options["certify_max_iters"] bounds the f64 fallback's
+        # budget: on accelerators without f64 this path runs on the
+        # host CPU, and an uncapped 100k-iteration re-solve of a
+        # large straggler set can dominate wall-clock (r4 UC-on-TPU
+        # timeout); a capped certify still improves stragglers and
+        # the Ebound mask keeps unrescued ones out of the bound.
+        # Keyed on the RESOLVED budget so an extension rescheduling
+        # the option mid-run gets a fresh solver, not a stale cache.
+        cert_iters = int(self.options.get(
+            "certify_max_iters", max(self.solver.max_iters, 100000)))
+        if self._solver64 is None or \
+                self._solver64.max_iters != cert_iters:
             self._solver64 = PDHGSolver(
-                max_iters=int(self.options.get(
-                    "certify_max_iters",
-                    max(self.solver.max_iters, 100000))),
+                max_iters=cert_iters,
                 eps=self.solver.eps,
                 check_every=self.solver.check_every,
                 restart_every=self.solver.restart_every)
@@ -241,11 +250,25 @@ class SPOpt(SPBase):
                    if cpu is not None else jnp.asarray)
             full = self._np_cache.get(prep_key)
             if full is None:
-                full = prepare_batch(
-                    put(np.asarray(A, np.float64)),
-                    put(np.asarray(row_lo, np.float64)),
-                    put(np.asarray(row_hi, np.float64)),
-                    shared_cols=self._shared_cols)
+                if isinstance(A, SplitA):
+                    # split-native constraint data: the f64 prep stays
+                    # split too (the dense (S, M, N) tensor may be
+                    # unmaterializable at true-size instances)
+                    a64 = SplitA(
+                        shared=put(np.asarray(A.shared, np.float64)),
+                        rows=put(np.asarray(A.rows)),
+                        cols=put(np.asarray(A.cols)),
+                        vals=put(np.asarray(A.vals, np.float64)))
+                    full = prepare_split_native(
+                        a64,
+                        put(np.asarray(row_lo, np.float64)),
+                        put(np.asarray(row_hi, np.float64)))
+                else:
+                    full = prepare_batch(
+                        put(np.asarray(A, np.float64)),
+                        put(np.asarray(row_lo, np.float64)),
+                        put(np.asarray(row_hi, np.float64)),
+                        shared_cols=self._shared_cols)
                 full = jax.tree.map(np.asarray, full)
                 self._np_cache[prep_key] = full
 
@@ -257,7 +280,22 @@ class SPOpt(SPBase):
                 # gathered to the straggler sub-batch
                 return a if (a.shape[0] == 1 and S_all > 1) else a[idx]
 
-            prep64 = jax.tree.map(lambda a: put(take(a)), full)
+            if isinstance(full.A, SplitA):
+                # only the per-scenario delta values gather; the shared
+                # matrix and coordinates serve every straggler as-is
+                sub_A = SplitA(shared=put(full.A.shared),
+                               rows=put(full.A.rows),
+                               cols=put(full.A.cols),
+                               vals=put(full.A.vals[idx]))
+                prep64 = PreparedBatch(
+                    A=sub_A,
+                    row_lo=put(take(full.row_lo)),
+                    row_hi=put(take(full.row_hi)),
+                    d_row=put(take(full.d_row)),
+                    d_col=put(take(full.d_col)),
+                    anorm=put(take(full.anorm)))
+            else:
+                prep64 = jax.tree.map(lambda a: put(take(a)), full)
             # row bounds may be call-specific (xhat candidates shift
             # them); rebuild the scaled fields from the raw bounds
             dr = np.asarray(take(np.asarray(full.d_row)))
@@ -468,9 +506,30 @@ class SPOpt(SPBase):
             pos = np.flatnonzero(stage <= upto_stage)
             na = na[pos]
         nai = jnp.asarray(na, jnp.int32)
-        A_na = jnp.take(b.A, nai, axis=2)              # (S, M, Kf)
         delta = delta_idx(b)
-        if (delta is not None and not b.shared_A
+        if b.split_A:
+            # split-native batch: the reduced system exists only if
+            # every scenario-varying entry sits in an ELIMINATED column
+            # (farmer: yields multiply the nonant acreages) — then
+            # A_red is the scenario-independent shared matrix with the
+            # nonant columns dropped, and the per-scenario part lives
+            # entirely in the A_na row-bound shift, expressed as a
+            # SplitA over the REDUCED (Kf-wide) column space
+            cols_np = np.asarray(b.A.cols)
+            if not np.all(np.isin(cols_np, na)):
+                raise NotImplementedError(
+                    "xhat evaluation on a split-native batch requires "
+                    "all A-delta columns to be eliminated (nonant) "
+                    "columns; this batch has deltas in kept columns")
+            pos_of = np.zeros(b.num_vars, np.int64)
+            pos_of[na] = np.arange(na.size)
+            A_na = SplitA(
+                shared=jnp.asarray(b.A.shared)[:, nai],   # (M, Kf)
+                rows=jnp.asarray(b.A.rows, jnp.int32),
+                cols=jnp.asarray(pos_of[cols_np], jnp.int32),
+                vals=b.A.vals)
+            A_red = jnp.asarray(b.A.shared)[None].at[:, :, nai].set(0.0)
+        elif (delta is not None and not b.shared_A
                 and not self.options.get("no_split_prep")
                 and np.all(np.isin(np.asarray(delta[1]), na))):
             # every scenario-varying matrix entry sits in an ELIMINATED
@@ -479,8 +538,10 @@ class SPOpt(SPBase):
             # (1, M, N) and every downstream solve rides the shared-A
             # matmul fast path (the per-scenario part lives entirely in
             # the A_na shift of the row bounds)
+            A_na = jnp.take(b.A, nai, axis=2)          # (S, M, Kf)
             A_red = jnp.asarray(b.A[0:1]).at[:, :, nai].set(0.0)
         else:
+            A_na = jnp.take(b.A, nai, axis=2)          # (S, M, Kf)
             A_red = jnp.asarray(b.A).at[:, :, nai].set(0.0)
         c_na = jnp.take(b.c, nai, axis=1)
         q_na = jnp.take(b.qdiag, nai, axis=1)
@@ -604,7 +665,11 @@ class SPOpt(SPBase):
 
             def tile(a):
                 # shared-A leaves (shape (1, ...)) serve every stacked
-                # candidate as-is; per-scenario leaves tile k-fold
+                # candidate as-is; per-scenario leaves tile k-fold.
+                # A SplitA tiles its per-scenario delta values only
+                if isinstance(a, SplitA):
+                    return SplitA(shared=a.shared, rows=a.rows,
+                                  cols=a.cols, vals=tile(a.vals))
                 if a.shape[0] == 1 and S_all > 1:
                     return a
                 return jnp.tile(a, (k,) + (1,) * (a.ndim - 1))
@@ -676,7 +741,11 @@ class SPOpt(SPBase):
             # per-candidate feasible probability mass — the diagnostic
             # for "feasible for MOST scenarios but screened out":
             # near-1 mass with feas=False means straggler solves, not
-            # an infeasible candidate
+            # an infeasible candidate.  Mass is the fraction of TOTAL
+            # probability mass: batch builders normalize prob to 1
+            # (stack_scenarios does so explicitly; pads carry 0), so
+            # this is a probability — the divisor guard only protects
+            # degenerate all-zero test batches, where mass is 0 anyway
             prob = np.asarray(b.prob)
             mass = (ok * prob[None, :]).sum(axis=1) / max(prob.sum(),
                                                           1e-12)

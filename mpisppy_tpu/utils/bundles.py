@@ -4,15 +4,21 @@ utils/pickle_bundle.py "proper bundles"; SURVEY.md §2.10).
 
 A bundle of m scenarios becomes ONE subproblem: constraint blocks on
 the diagonal, objectives weighted by within-bundle conditional
-probability, and (m-1)*K explicit nonanticipativity equality rows
-chaining the members' nonant columns — the same construction as the
-reference's per-bundle EF (sputils._create_EF_from_scen_dict), done on
-arrays.  The bundled batch is a plain ScenarioBatch, so every
-algorithm (PH, L-shaped, FWPH, EF) runs on bundles unchanged; PH's
-consensus then couples only across bundles.
+probability, and explicit nonanticipativity equality rows chaining the
+members' nonant columns — the same construction as the reference's
+per-bundle EF (sputils._create_EF_from_scen_dict), done on arrays.
+The bundled batch is a plain ScenarioBatch, so every algorithm (PH,
+L-shaped, FWPH, EF) runs on bundles unchanged; PH's consensus then
+couples only across bundles.
 
-Two-stage only (proper bundles make multistage 2-stage by construction
-in the reference as well — pickle_bundle.py:14-30).
+Multistage: a "proper bundle" consumes ENTIRE subtrees (the
+reference's constraint — pickle_bundle.py:14-30, aircondB.py:158-161:
+"bundles consume entire second stage nodes"), so every stage>=2 tree
+node is interior to one bundle.  The in-bundle nonanticipativity of
+those nodes becomes explicit chain rows, only the ROOT slots remain
+nonanticipative ACROSS bundles, and the bundled problem is TWO-STAGE
+by construction — exactly how the reference turns multistage aircond
+into two-stage pickled bundles.
 """
 
 from __future__ import annotations
@@ -24,31 +30,91 @@ from ..ir import ScenarioBatch, TreeInfo
 INF = float("inf")
 
 
+def _chain_pairs(node_of, stage_of, members):
+    """Chain specification for one bundle: list of (j_a, k, j_b) — tie
+    member j_a's nonant slot k to member j_b's — covering (a) every
+    stage-1 slot of every member j>0 chained to member 0, and (b) every
+    stage>=2 (node, slot) group chained within its members.  Raises if
+    a stage>=2 node's scenario set extends outside the bundle (the
+    bundle does not consume entire subtrees)."""
+    m = len(members)
+    K = node_of.shape[1]
+    pairs = []
+    for k in range(K):
+        if stage_of is not None and stage_of[k] == 1:
+            for j in range(1, m):
+                pairs.append((j, k, 0))
+            continue
+        # group members by the node owning slot k
+        groups = {}
+        for j, s in enumerate(members):
+            groups.setdefault(int(node_of[s, k]), []).append(j)
+        for js in groups.values():
+            for j in js[1:]:
+                pairs.append((j, k, js[0]))
+    return pairs
+
+
 def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
     """Stack every `scenarios_per_bundle` consecutive scenarios into a
     bundle.  S must be divisible by the bundle size (the reference
-    likewise requires equal bundles, spbase.py:219 _assign_bundles)."""
+    likewise requires equal bundles, spbase.py:219 _assign_bundles).
+    Multistage batches additionally require each bundle to consume
+    entire stage>=2 subtrees (proper bundles)."""
     m = int(scenarios_per_bundle)
     S = batch.num_scens
     if m <= 1:
         return batch
     if S % m:
         raise ValueError(f"num_scens {S} not divisible by bundle size {m}")
-    if int(np.asarray(batch.tree.node_of).max()) > 0:
-        raise ValueError("bundle_batch is two-stage only")
+    if batch.var_prob is not None:
+        raise ValueError("bundle_batch does not support "
+                         "variable_probability")
     B = S // m
     N, M, K = batch.num_vars, batch.num_rows, batch.num_nonants
     na = np.asarray(batch.nonant_idx)
+    node_of = np.asarray(batch.tree.node_of)
+    stage_of = (np.asarray(batch.tree.stage_of)
+                if batch.tree.stage_of is not None else None)
+    multistage = int(node_of.max()) > 0
+    if multistage and stage_of is None:
+        raise ValueError("multistage bundling needs tree.stage_of")
     A = np.asarray(batch.A)
     prob = np.asarray(batch.prob)
+
+    # proper-bundle check in ONE pass: every stage>=2 node must be
+    # touched by exactly one bundle (scenario s belongs to bundle
+    # s // m, so a node's scenario set maps to one bundle id)
+    if multistage:
+        deep = np.flatnonzero(stage_of > 1)
+        node_ids = node_of[:, deep]                       # (S, Kd)
+        bundle_of = (np.arange(S) // m)[:, None]
+        owner = {}
+        for n, b in zip(node_ids.ravel().tolist(),
+                        np.broadcast_to(bundle_of,
+                                        node_ids.shape).ravel().tolist()):
+            if owner.setdefault(n, b) != b:
+                raise ValueError(
+                    "proper bundles must consume entire subtrees: a "
+                    "stage>=2 tree node is shared across bundles "
+                    "(choose scenarios_per_bundle as a multiple of "
+                    "the leaves per stage-2 subtree)")
+    all_pairs = [
+        _chain_pairs(node_of, stage_of,
+                     list(range(b * m, (b + 1) * m)))
+        for b in range(B)]
+    n_chain = max(len(p) for p in all_pairs)
+    # identical chain patterns across bundles keep the shared-A fast
+    # path available (regular trees — aircond — always qualify)
+    uniform_chains = all(p == all_pairs[0] for p in all_pairs)
     # a shared-A batch bundles to a shared-A batch: every bundle's
     # block-diagonal is the same matrix (A identical across members,
     # nonant-chain rows constant), so Ab stays (1, Mb, Nb) and the
     # bmatvec matmul fast path survives bundling
-    shared = batch.shared_A
+    shared = batch.shared_A and uniform_chains
 
     Nb = m * N
-    Mb = m * M + (m - 1) * K
+    Mb = m * M + n_chain
     Ab = np.zeros((1 if shared else B, Mb, Nb))
     lob = np.full((B, Mb), -INF)
     hib = np.full((B, Mb), INF)
@@ -78,7 +144,7 @@ def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
             sl = slice(j * N, (j + 1) * N)
             rw = slice(j * M, (j + 1) * M)
             if not shared:
-                Ab[b, rw, sl] = A[s]
+                Ab[b, rw, sl] = A[s] if A.shape[0] > 1 else A[0]
             lob[b, rw] = lo[s]
             hib[b, rw] = hi[s]
             cb[b, sl] = w * c[s]
@@ -87,26 +153,24 @@ def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
             ubb[b, sl] = ub[s]
             intb[b, sl] = im[s]
             constb[b] += w * oc[s]
-        # nonant chains: member j's nonants == member 0's (equality
-        # row bounds per bundle; the matrix entries per A block below)
-        lob[b, m * M:] = 0.0
-        hib[b, m * M:] = 0.0
+        # nonant chains (equality row bounds; matrix entries below)
+        pairs = all_pairs[b]
+        lob[b, m * M:m * M + len(pairs)] = 0.0
+        hib[b, m * M:m * M + len(pairs)] = 0.0
         if not shared:
-            for j in range(1, m):
-                for k in range(K):
-                    r = m * M + (j - 1) * K + k
-                    Ab[b, r, na[k]] = 1.0
-                    Ab[b, r, j * N + na[k]] = -1.0
+            for r0, (ja, k, jb) in enumerate(pairs):
+                r = m * M + r0
+                Ab[b, r, ja * N + na[k]] = 1.0
+                Ab[b, r, jb * N + na[k]] = -1.0
     if shared:
         # ONE block-diagonal serves every bundle (members share A and
         # the chain rows are constant)
         for j in range(m):
             Ab[0, j * M:(j + 1) * M, j * N:(j + 1) * N] = A[0]
-        for j in range(1, m):
-            for k in range(K):
-                r = m * M + (j - 1) * K + k
-                Ab[0, r, na[k]] = 1.0
-                Ab[0, r, j * N + na[k]] = -1.0
+        for r0, (ja, k, jb) in enumerate(all_pairs[0]):
+            r = m * M + r0
+            Ab[0, r, ja * N + na[k]] = 1.0
+            Ab[0, r, jb * N + na[k]] = -1.0
 
     # remap sparse matrix-uncertainty coordinates (ir.SplitA contract)
     # to the bundled block-diagonal layout: member j's delta entry
@@ -127,18 +191,29 @@ def bundle_batch(batch: ScenarioBatch, scenarios_per_bundle: int):
                 np.concatenate([j * N + c0 for j in range(m)]).astype(
                     np.int32))
     names = batch.tree.scen_names or tuple(str(i) for i in range(S))
+    # the bundled problem is TWO-STAGE: only member 0's ROOT slots stay
+    # nonanticipative across bundles (multistage slots are chained
+    # inside each bundle above)
+    if multistage:
+        keep = np.flatnonzero(stage_of == 1)
+    else:
+        keep = np.arange(K)
+    nonant_idx_b = na[keep].astype(np.int32)
+    Kb = keep.size
     tree = TreeInfo(
-        node_of=np.zeros((B, K), np.int32),
+        node_of=np.zeros((B, Kb), np.int32),
         prob=pb / pb.sum(),
         num_nodes=1,
-        stage_of=batch.tree.stage_of,
-        nonant_names=batch.tree.nonant_names,
+        stage_of=(1,) * Kb,
+        nonant_names=tuple(np.asarray(
+            batch.tree.nonant_names or tuple(str(k) for k in range(K))
+        )[keep]),
         scen_names=tuple(f"bundle{b}({names[b*m]}..{names[(b+1)*m-1]})"
                          for b in range(B)),
     )
     return ScenarioBatch(
         c=cb, qdiag=qb, A=Ab, row_lo=lob, row_hi=hib, lb=lbb, ub=ubb,
-        obj_const=constb, nonant_idx=batch.nonant_idx,
+        obj_const=constb, nonant_idx=nonant_idx_b,
         integer_mask=intb, tree=tree,
         stage_cost_c=None,
         model_meta=meta if meta is not None else batch.model_meta,

@@ -69,3 +69,24 @@ def dill_unpickle(path) -> ScenarioBatch:
         stage_cost_c=z["stage_cost_c"] if meta["has_stage_cost"] else None,
         var_names=tuple(meta["var_names"]),
     )
+
+
+def pickle_bundle_parser(cfg):
+    """Config flags for the pickled-bundle workflow (reference
+    pickle_bundle.py:37-55 pickle_bundle_parser)."""
+    cfg.add_to_config("pickle_bundles_dir",
+                      description="write per-bundle npz files here",
+                      domain=str, default=None)
+    cfg.add_to_config("unpickle_bundles_dir",
+                      description="read per-bundle npz files from here "
+                      "instead of building the model",
+                      domain=str, default=None)
+    cfg.add_to_config("scenarios_per_bundle",
+                      description="scenarios per proper bundle",
+                      domain=int, default=None)
+
+
+def have_proper_bundles(cfg):
+    """Reference pickle_bundle.py:58-64: is a bundle workflow active?"""
+    return (cfg.get("pickle_bundles_dir") is not None
+            or cfg.get("unpickle_bundles_dir") is not None)

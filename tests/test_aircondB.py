@@ -1,0 +1,62 @@
+"""aircondB (pickle-bundle aircond) + multistage proper bundles
+(reference: mpisppy/tests/examples/aircondB.py, utils/pickle_bundle.py
+— bundles consume entire stage-2 subtrees, making each bundle a
+two-stage subproblem; written/read as per-bundle files)."""
+
+import numpy as np
+import pytest
+
+from efcheck import ef_linprog
+from mpisppy_tpu.models import aircond, aircondB
+from mpisppy_tpu.opt.ph import PH
+from mpisppy_tpu.utils.bundles import bundle_batch
+
+BF = (3, 2)
+
+
+def test_proper_bundle_is_two_stage():
+    bb = aircondB.build_batch(BF)
+    assert bb.num_scens == 3                # one bundle per subtree
+    assert int(np.asarray(bb.tree.node_of).max()) == 0
+    base = aircond.build_batch(BF)
+    # only the ROOT slots remain nonanticipative across bundles
+    stage = np.asarray(base.tree.stage_of)
+    assert bb.num_nonants == int((stage == 1).sum())
+
+
+def test_bundled_ef_matches_multistage_ef():
+    base = aircond.build_batch(BF)
+    bb = aircondB.build_batch(BF)
+    ref, _ = ef_linprog(base, n_real=base.num_scens)
+    got, _ = ef_linprog(bb, n_real=bb.num_scens)
+    assert got == pytest.approx(ref, rel=1e-8)
+
+
+def test_misaligned_bundle_raises():
+    base = aircond.build_batch(BF)
+    with pytest.raises(ValueError, match="entire subtrees"):
+        bundle_batch(base, 3)   # 3 leaves != multiple of 2-leaf subtree
+
+
+def test_pickle_roundtrip_dir(tmp_path):
+    d = str(tmp_path / "bundles")
+    bb = aircondB.build_batch(BF, pickle_bundles_dir=d)
+    bb2 = aircondB.build_batch(BF, unpickle_bundles_dir=d)
+    assert bb2.num_scens == bb.num_scens
+    for f in ("c", "row_lo", "row_hi", "lb", "ub", "obj_const"):
+        np.testing.assert_allclose(np.asarray(getattr(bb2, f)),
+                                   np.asarray(getattr(bb, f)))
+    ref, _ = ef_linprog(bb, n_real=bb.num_scens)
+    got, _ = ef_linprog(bb2, n_real=bb2.num_scens)
+    assert got == pytest.approx(ref, rel=1e-10)
+
+
+def test_ph_on_proper_bundles():
+    bb = aircondB.build_batch(BF)
+    names = aircondB.scenario_names_creator(
+        int(np.prod(BF)), scenarios_per_bundle=2)
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 60,
+             "convthresh": 1e-5, "pdhg_eps": 1e-7}, names, batch=bb)
+    conv, eobj, triv = ph.ph_main()
+    ref, _ = ef_linprog(aircond.build_batch(BF), n_real=6)
+    assert eobj == pytest.approx(ref, abs=0.02 * abs(ref) + 1.0)

@@ -199,3 +199,34 @@ class TestFailureTolerance:
         assert hub.failed_spokes[0][0] == "ExplodingSpoke"
         assert np.isfinite(ws.BestInnerBound)
         assert np.isfinite(ws.BestOuterBound)
+
+    def test_threads_hung_spoke_bounded_shutdown(self):
+        """A spoke stuck in a pathological solve (never checks the
+        kill signal) must not block shutdown forever: the bounded join
+        escalates it through the spoke-failure pruning path and the
+        wheel terminates with the healthy spokes' results (the
+        reference's kill protocol always terminates,
+        spin_the_wheel.py:119-144)."""
+        import time as _time
+
+        class HungSpoke(LagrangianOuterBound):
+            def main(self):
+                t0 = _time.time()
+                while _time.time() - t0 < 60.0:   # ignores the kill
+                    _time.sleep(0.05)             # signal entirely
+
+        ws = farmer_wheel([(HungSpoke, PH),
+                           (XhatShuffleInnerBound, Xhat_Eval)],
+                          mode="threads",
+                          hub_opts={"shutdown_join_timeout": 5.0})
+        t0 = _time.time()
+        ws.spin()
+        hub = ws.spcomm
+        # shutdown took the bounded join, not the 60 s hang
+        hung = [sp for sp in hub.spokes
+                if getattr(sp, "_failed", False)]
+        assert len(hung) == 1
+        assert isinstance(hung[0], HungSpoke)
+        assert any("did not exit" in msg for _, msg in hub.failed_spokes)
+        assert np.isfinite(ws.BestInnerBound)
+        assert abs(ws.BestInnerBound - -108390.0) < 50.0

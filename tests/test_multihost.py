@@ -1,0 +1,86 @@
+"""Real jax.distributed multi-host path (2 processes x 2 CPU devices):
+the consensus psum crosses a PROCESS boundary — the single-box stand-in
+for the reference's inter-node MPI traffic (reference
+spin_the_wheel.py:219-237 rank grid over cluster nodes; SURVEY §2.3).
+
+Spawns tests/multihost_worker.py twice with a shared coordinator; both
+processes run farmer PH on the GLOBAL 4-device mesh and print their
+trajectory.  Asserts (a) the two processes agree exactly (they execute
+one SPMD program), and (b) the numbers match a plain single-process
+run of the same instance (the mesh is invisible to the math).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def multihost_results():
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coord, "2", str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env) for pid in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("RESULT ")][-1]
+        outs.append(json.loads(line[len("RESULT "):]))
+    return outs
+
+
+def test_processes_agree(multihost_results):
+    a, b = multihost_results
+    assert a["process_count"] == b["process_count"] == 2
+    assert a["devices"] == b["devices"] == 4
+    # one SPMD program: identical numbers on both controllers
+    assert a["trivial_bound"] == pytest.approx(b["trivial_bound"],
+                                               rel=1e-12)
+    np.testing.assert_allclose(a["convs"], b["convs"], rtol=1e-10)
+    assert a["lagrangian"] == pytest.approx(b["lagrangian"], rel=1e-12)
+    np.testing.assert_allclose(a["xbar0"], b["xbar0"], rtol=1e-10)
+
+
+def test_matches_single_process(multihost_results):
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.opt.ph import PH
+
+    a = multihost_results[0]
+    S = 8
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 5, "convthresh": 0.0,
+             "pdhg_eps": 1e-7, "iter0_certify": False},
+            [f"scen{i}" for i in range(S)],
+            batch=farmer.build_batch(S))
+    ph.Iter0()
+    convs = [ph.ph_iteration() for _ in range(5)]
+    assert a["trivial_bound"] == pytest.approx(ph.trivial_bound,
+                                               rel=1e-8)
+    np.testing.assert_allclose(a["convs"], convs, rtol=1e-5, atol=1e-9)
+    assert a["lagrangian"] == pytest.approx(ph.lagrangian_bound(),
+                                            rel=1e-6)

@@ -151,3 +151,30 @@ def test_iter0_certify_off_and_certify_budget(monkeypatch):
         select=np.ones(ph.batch.num_scens, bool))
     assert ph._solver64 is not None
     assert ph._solver64.max_iters == 1234
+
+
+def test_farmer_4096_scenarios_sharded_gap():
+    """farmer-10k tier (BASELINE.md target row 'farmer, 10,000 scen')
+    at test scale: S=4096 sharded over the 8-virtual-device mesh, PH
+    to a VERIFIED <=1% outer/inner gap — the same protocol the
+    BENCH_SCENS=10000 artifact runs on the TPU."""
+    S = 4096
+    b = farmer.build_batch(S)
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 60, "convthresh": 0.0,
+             "pdhg_eps": 1e-6, "superstep_eps": 1e-4,
+             "lagrangian_eps": 1e-4},
+            [f"scen{i}" for i in range(S)], batch=b)
+    assert ph.batch.num_scens == S          # 4096 = 8 * 512, no pad
+    ph.Iter0()
+    outer = ph.trivial_bound
+    gap = np.inf
+    for k in range(60):
+        ph.ph_iteration()
+        if (k + 1) % 4 == 0:
+            inner, feas = ph.evaluate_xhat(ph.root_xbar())
+            outer = max(outer, ph.lagrangian_bound())
+            if feas:
+                gap = abs(inner - outer) / max(abs(inner), 1e-9)
+            if gap <= 0.01:
+                break
+    assert gap <= 0.01

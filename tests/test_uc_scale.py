@@ -314,3 +314,68 @@ def test_uc_spinning_reserve_rows():
     # reserve binds the commitment: all-on objective >= no-reserve one
     v0, _ = ph0.evaluate_xhat(all_on)
     assert vr >= v0 - 1e-6 * (1 + abs(v0))
+
+
+def test_infeasible_uc_detected_without_iter0_certify():
+    """The bench's UC path disables the iter0 certified hard-stop
+    (iter0_certify=False + iter0_infeasibility_ok=True) on the
+    argument that UC is structurally feasible (load shed) and the
+    published bounds are validated independently.  This test closes
+    the loophole: a GENUINELY infeasible variant (shed capped to zero,
+    demand above fleet capacity) must still be caught by that
+    independent validation — iter0 feasible mass collapses and every
+    recovered-commitment candidate fails the feasibility screen, so
+    the bench reports 'no feasible commitment candidate' instead of a
+    gap (bench.py worker_uc)."""
+    import dataclasses
+
+    S, H = 8, 4
+    b = uc.build_batch(S, H=H)
+    G = 3
+    ub = np.asarray(b.ub).copy()
+    ub[:, 3 * G * H:] = 0.0                  # no load shed allowed
+    row_lo = np.asarray(b.row_lo).copy()
+    cap = 700.0                              # fleet Pmax sum
+    bal = 2 * G * H + np.arange(H)           # balance row indices
+    row_lo[:, bal] = 10.0 * cap              # unserviceable demand
+    b = dataclasses.replace(b, ub=ub, row_lo=row_lo)
+
+    ph = PH({"defaultPHrho": 50.0, "PHIterLimit": 2, "convthresh": 0.0,
+             "pdhg_eps": 1e-6, "pdhg_max_iters": 20000,
+             "iter0_certify": False, "iter0_infeasibility_ok": True},
+            [f"s{i}" for i in range(S)], batch=b)
+    ph.Iter0()
+    # the uncertified iter0 path still SEES the infeasibility
+    assert ph.iter0_feas_mass < 0.5
+    ph.ph_iteration()
+    xbar = np.asarray(ph.state.xbar)[0]
+    cands = uc.commitment_candidates(b, xbar)
+    objs, feas, mass = ph.evaluate_candidates(cands, return_mass=True)
+    # every candidate fails the independent feasibility screen — the
+    # bench path publishes value -1, never a gap/incumbent
+    assert not np.any(feas)
+    assert float(np.max(mass)) < 0.5
+
+
+def test_infeasible_uc_raises_with_message_when_not_ok():
+    """Without iter0_infeasibility_ok the uncertified iter0 hard-stops,
+    and the message says certification was SKIPPED (ADVICE r4: the old
+    message claimed 'after certified re-solve' even when
+    iter0_certify=False)."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    S, H = 4, 3
+    b = uc.build_batch(S, H=H)
+    G = 3
+    ub = np.asarray(b.ub).copy()
+    ub[:, 3 * G * H:] = 0.0
+    row_lo = np.asarray(b.row_lo).copy()
+    row_lo[:, 2 * G * H + np.arange(H)] = 7000.0
+    b = dataclasses.replace(b, ub=ub, row_lo=row_lo)
+    ph = PH({"defaultPHrho": 50.0, "PHIterLimit": 1, "convthresh": 0.0,
+             "pdhg_eps": 1e-6, "iter0_certify": False},
+            [f"s{i}" for i in range(S)], batch=b)
+    with _pytest.raises(RuntimeError, match="UNCERTIFIED"):
+        ph.Iter0()

@@ -1,0 +1,111 @@
+"""Reference-data UC (models/uc_wecc.py): parse + lower the ACTUAL
+WECC-240 instances the reference ships (reference
+examples/uc/3scenarios_r1/ — uc_funcs.py loads the same files through
+egret), and validate the lowering against the scipy EF oracle."""
+
+import numpy as np
+import pytest
+
+from efcheck import ef_linprog
+from mpisppy_tpu.models import uc_wecc
+from mpisppy_tpu.opt.ph import PH
+
+DATA = "/root/reference/examples/uc/3scenarios_r1"
+
+
+def small(**kw):
+    kw.setdefault("data_dir", DATA)
+    kw.setdefault("num_scens", 3)
+    kw.setdefault("hours", 4)
+    kw.setdefault("max_units", 20)
+    return uc_wecc.build_batch(**kw)
+
+
+def test_parse_demand_matches_file():
+    d = uc_wecc.parse_demand(f"{DATA}/Node1.dat", 48)
+    assert d[0] == pytest.approx(384.788341022)
+    assert d[11] == pytest.approx(826.741784622)
+    assert d[47] == pytest.approx(408.981525761)
+
+
+def test_parse_root_fleet():
+    root = uc_wecc.parse_root(f"{DATA}/RootNode.dat")
+    assert root["H"] == 48 and len(root["gens"]) == 85
+    t = root["table"]["BRIDGER_20_6333_C"]
+    # PowerGeneratedT0 UnitOnT0State Pmin Pmax UT DT RU RD SUr SDr Fuel
+    assert t[:6] == pytest.approx(
+        [14.05945, 23, 7.40250, 29.61, 12, 12])
+    assert root["su_lags"]["BRIDGER_20_6333_C"] == [12, 14, 18]
+    assert root["pw_values"]["CANAD_G1_20_5031_G"][0] == \
+        pytest.approx(865.15)
+
+
+def test_lowered_batch_shape_and_sharing():
+    b = small()
+    assert b.shared_A                      # demand lives in row bounds
+    assert b.num_scens == 3
+    G, H = int(b.model_meta["G"]), int(b.model_meta["H"])
+    assert (G, H) == (20, 4)
+    assert b.num_nonants == G * H          # UnitOn only
+    assert all(n.startswith("UnitOn[") for n in b.tree.nonant_names)
+    # per-scenario demand reached the balance rows
+    d1 = uc_wecc.parse_demand(f"{DATA}/Node1.dat", 48)[:4]
+    d2 = uc_wecc.parse_demand(f"{DATA}/Node2.dat", 48)[:4]
+    assert not np.allclose(d1, d2)
+
+
+def test_t0_initial_commitment_holds():
+    """DIABLO1 (nuclear, UT=48) was only on 1 hour at T0: the scaled
+    min-up obligation pins it ON through the truncated horizon."""
+    b = uc_wecc.build_batch(data_dir=DATA, num_scens=3, hours=4)
+    gens = b.model_meta["gens"].value
+    H = int(b.model_meta["H"])
+    gi = gens.index("DIABLO1_20_3831_NN")
+    lb = np.asarray(b.lb)
+    assert np.all(lb[:, gi * H:(gi + 1) * H] == 1.0)
+    # a unit off at T0 with a long min-down stays off initially
+    root = uc_wecc.parse_root(f"{DATA}/RootNode.dat")
+    ub = np.asarray(b.ub)
+    for i, g in enumerate(gens):
+        t0 = root["table"][g][1]
+        if t0 < 0 and round(-t0 / 12) < max(
+                1, round(root["table"][g][5] / 12)):
+            assert ub[0, i * H] == 0.0
+            break
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    b = small()
+    val, x = ef_linprog(b, n_real=3)
+    return b, val, x
+
+
+def test_ef_lp_is_sane(oracle):
+    b, val, x = oracle
+    assert np.isfinite(val) and val > 0
+    # load mismatch slacks are (near) unused at the optimum — the
+    # instance is feasible without paying the 1e6 penalty
+    meta = b.model_meta
+    G, H = int(meta["G"]), int(meta["H"])
+    N = b.num_vars
+    shed = x[:, N - 2 * H:]
+    assert float(np.abs(shed).max()) < 1e-5
+
+
+def test_ph_bounds_bracket_oracle(oracle):
+    b, val, _ = oracle
+    ph = PH({"defaultPHrho": 50.0, "PHIterLimit": 10,
+             "convthresh": 0.0, "pdhg_eps": 1e-6,
+             "pdhg_max_iters": 60000},
+            uc_wecc.scenario_names_creator(3), batch=b)
+    ph.Iter0()
+    for _ in range(10):
+        ph.ph_iteration()
+    outer = max(ph.trivial_bound, ph.lagrangian_bound())
+    assert outer <= val + 1e-4 * abs(val)
+    inner, feas = ph.evaluate_xhat(ph.root_xbar())
+    assert feas
+    assert inner >= val - 1e-4 * abs(val)
+    # LP consensus is near-tight on this instance slice
+    assert (inner - outer) / abs(val) < 0.3

@@ -8,7 +8,7 @@ import pytest
 from efcheck import ef_linprog
 from mpisppy_tpu.extensions.extension import MultiExtension
 from mpisppy_tpu.extensions.xhatter import (
-    XhatClosest, XhatSpecific, XhatXbar,
+    XhatClosest, XhatLooper, XhatSpecific, XhatXbar,
 )
 from mpisppy_tpu.models import farmer
 from mpisppy_tpu.opt.ph import PH
@@ -30,7 +30,9 @@ def run_ph(ext_cls, ext_options=None, S=3):
     return ph, b
 
 
-@pytest.mark.parametrize("ext_cls", [XhatClosest, XhatXbar, XhatSpecific])
+@pytest.mark.parametrize("ext_cls",
+                         [XhatClosest, XhatXbar, XhatSpecific,
+                          XhatLooper])
 def test_inhub_xhat_inner_bound(ext_cls):
     ph, b = run_ph(ext_cls)
     ref, _ = ef_linprog(b, n_real=3)          # -108390
@@ -52,3 +54,18 @@ def test_xhat_closest_picks_nearest_scenario():
     xbar = np.asarray(ph.state.xbar)[0]
     d = np.sum((x_na - xbar[None, :]) ** 2, axis=1)
     assert np.allclose(cands[0], x_na[np.argmin(d)])
+
+
+def test_xhat_looper_walks_scenarios():
+    """The looper's walk position advances cyclically: successive
+    passes cover different scenario solutions (reference
+    extensions/xhatlooper.py scen_limit walk)."""
+    ph, _ = run_ph(XhatLooper, ext_options={"scen_limit": 2})
+    ext = ph.extobject.extdict["XhatLooper"]
+    x_na = np.asarray(ph.batch.nonants(ph.state.x))[:3]
+    ext._pos = 0
+    c1 = ext.candidates()
+    c2 = ext.candidates()
+    assert c1.shape == (2, x_na.shape[1])
+    assert np.allclose(c1, x_na[[0, 1]])
+    assert np.allclose(c2, x_na[[2, 0]])   # wrapped
