@@ -71,6 +71,9 @@ CORPUS = [
     ("acopf3_cylinders.py",
      "--branching-factors 2,2 --max-iterations 30 --default-rho 5 "
      "--lagrangian --xhatshuffle"),
+    # AC fidelity: Jabr SOC relaxation + cone-cut refinement, then PH
+    ("acopf3_soc.py",
+     "--branching-factors 2,2 --rounds 4 --max-iterations 8"),
 ]
 
 FAST = {"farmer_cylinders.py", "farmer_lshapedhub.py",

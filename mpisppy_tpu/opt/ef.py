@@ -39,14 +39,19 @@ class ExtensiveForm(SPOpt):
         self._result = None
 
     def solve_extensive_form(self, solver_options=None, tee=False,
-                             certify=True):
+                             certify=True, x0=None, y0=None):
         """One batched consensus solve == the reference's single
         monolithic solver call (opt/ef.py:66).
 
         certify: if the fast solve leaves the (single, coupled) EF
         unconverged, re-solve the FULL batch in float64 warm-started —
         the consensus system cannot be subset the way the per-scenario
-        fallback (spopt._certified_resolve) does."""
+        fallback (spopt._certified_resolve) does.
+
+        x0/y0: optional warm starts (user space) — sequential-
+        relaxation callers (models/acopf3.soc_refine's cut loop) hand
+        each round the previous round's iterates, the persistent-
+        solver analog."""
         b = self.batch
         p = b.prob[:, None]
         res = self.solver.solve(
@@ -55,6 +60,7 @@ class ExtensiveForm(SPOpt):
             b.qdiag * p,
             b.lb, b.ub,
             obj_const=b.obj_const * b.prob,
+            x0=x0, y0=y0,
             consensus=self.consensus)
         if certify and not bool(jnp.all(res.converged)):
             res = self._certified_ef_resolve(res)

