@@ -316,6 +316,7 @@ def worker_uc():
     # incumbent slack (the instance's true integrality gap is ~2.8%).
     # Its cost is reported as ef_bound_s.
     from mpisppy_tpu.opt.ef import ef_dual_bound
+    from mpisppy_tpu.resilience import wheel_counters
     ef_b, ef_bound_s = ef_dual_bound(b, ph.all_scenario_names)
     tic(f"EF dual bound done ({ef_bound_s:.1f}s)")
     outer = max(outer, ef_b)
@@ -338,7 +339,8 @@ def worker_uc():
         # comment); the bounds above are valid regardless
         "iter0_feas_mass": round(
             getattr(ph, "iter0_feas_mass", 1.0), 4),
-        "shared_A": bool(b.shared_A)}))
+        "shared_A": bool(b.shared_A),
+        **wheel_counters(ph)}))
 
 
 def worker():
@@ -449,8 +451,12 @@ def worker():
     jax.block_until_ready(ph.state.x)
     wall = time.time() - t0
     stats = ph.solve_stats()
+    from mpisppy_tpu.resilience import wheel_counters
     extra = {
         "iters": iters,
+        # resilience counters: 0/0 on a healthy run; nonzero when the
+        # spoke supervisor restarted or pruned cylinders mid-bench
+        **wheel_counters(ph),
         "iters_per_sec": round(iters / wall, 3),
         "mfu": (round(stats["mfu"], 6) if stats["mfu"] is not None
                 else None),

@@ -8,9 +8,12 @@ BestOuterBound, and decides gap-based termination
 
 from __future__ import annotations
 
+import collections
+
 import numpy as np
 
 from .. import global_toc
+from ..resilience.bounds import BoundGuard
 from .spcommunicator import SPCommunicator, WindowPair
 from .spoke import ConvergerSpokeType
 
@@ -51,19 +54,38 @@ class Hub(SPCommunicator):
         # spokes report failures through a queue drained on the hub
         # thread (the index sets must not be mutated concurrently).
         self.failed_spokes = []
-        self._failed_queue = []
+        # deque: appends from spoke threads race the hub-thread drain
+        self._failed_queue = collections.deque()
+        # multiproc-mode process supervision (resilience/supervisor.py);
+        # set by the wheel, polled from sync()
+        self.supervisor = None
+        self.spoke_exit_reports = []
+        # bound hygiene at the window-read boundary
+        # (resilience/bounds.py): a sick spoke degrades (rejected
+        # messages + eventual pruning) instead of corrupting
+        # BestInnerBound/BestOuterBound
+        self._bound_guard = (
+            BoundGuard(rtol=self.options.get("bound_cross_rtol", 1e-2))
+            if self.options.get("bound_guard", True) else None)
+        self._max_bound_rejects = int(
+            self.options.get("max_bound_rejects", 25))
 
     def _mark_spoke_failed(self, i, exc):
         """Prune spoke i out of every wiring set (hub thread only)."""
         sp = self.spokes[i]
+        if getattr(sp, "_failed", False):
+            return                      # already pruned (racing reports)
         sp._failed = True
         for idx_set in (self.outerbound_idx, self.innerbound_idx,
                         self.w_idx, self.nonant_idx_set):
             idx_set.discard(i)
         self.has_outerbound_spokes = bool(self.outerbound_idx)
         self.has_innerbound_spokes = bool(self.innerbound_idx)
-        self.failed_spokes.append((type(sp).__name__, str(exc)))
-        global_toc(f"WARNING: spoke {type(sp).__name__} failed and "
+        # multiproc SpokeHandles carry the real spoke class in
+        # spoke_name (the handle type itself would be meaningless)
+        name = getattr(sp, "spoke_name", type(sp).__name__)
+        self.failed_spokes.append((name, str(exc)))
+        global_toc(f"WARNING: spoke {name} failed and "
                    f"was removed from the wheel: {exc}")
 
     def report_spoke_failure(self, spoke, exc):
@@ -72,9 +94,15 @@ class Hub(SPCommunicator):
         self._failed_queue.append((spoke, exc))
 
     def _drain_failures(self):
-        while self._failed_queue:
-            spoke, exc = self._failed_queue.pop(0)
-            i = self.spokes.index(spoke)
+        while True:
+            try:
+                spoke, exc = self._failed_queue.popleft()
+            except IndexError:
+                break
+            try:
+                i = self.spokes.index(spoke)
+            except ValueError:
+                continue                # unknown reporter; nothing to prune
             if not getattr(spoke, "_failed", False):
                 self._mark_spoke_failed(i, exc)
 
@@ -114,6 +142,7 @@ class Hub(SPCommunicator):
             sp.pair = pair
             self.pairs.append(pair)
         self._spoke_read_ids = np.zeros(len(self.spokes), np.int64)
+        self.bound_rejects = np.zeros(len(self.spokes), np.int64)
         self.has_outerbound_spokes = bool(self.outerbound_idx)
         self.has_innerbound_spokes = bool(self.innerbound_idx)
         # auto-wire extensions that consume a spoke's feed (the
@@ -183,18 +212,47 @@ class Hub(SPCommunicator):
         self.latest_ob_char = None
 
     # -- bound intake (reference hub.py:174-227) --------------------------
+    def _accept_bound(self, kind, value, i):
+        """Window-read hygiene: screen one incoming bound; on reject,
+        count it and (past the budget) prune the spoke.  Returns True
+        iff the bound may enter Best{Inner,Outer}Bound."""
+        if self._bound_guard is None:
+            return True
+        ok, reason = self._bound_guard.check(
+            kind, value, inner=self.BestInnerBound,
+            outer=self.BestOuterBound,
+            minimizing=self.opt.is_minimizing)
+        if ok:
+            return True
+        self.bound_rejects[i] += 1
+        n = int(self.bound_rejects[i])
+        if n == 1 or n % 10 == 0:       # don't spam a steady NaN stream
+            name = getattr(self.spokes[i], "spoke_name",
+                           type(self.spokes[i]).__name__)
+            global_toc(f"WARNING: rejected bound from spoke {i} "
+                       f"({name}): {reason} "
+                       f"[{n} rejected so far]")
+        if (n >= self._max_bound_rejects
+                and not getattr(self.spokes[i], "_failed", False)):
+            self._mark_spoke_failed(i, RuntimeError(
+                f"{n} rejected bounds (last: {reason})"))
+        return False
+
     def receive_outerbounds(self):
-        for i in self.outerbound_idx:
+        for i in list(self.outerbound_idx):
             data, wid = self.pairs[i].to_hub.read()
             if wid > self._spoke_read_ids[i]:
                 self._spoke_read_ids[i] = wid
-                self.OuterBoundUpdate(float(data[0]), i)
+                if self._accept_bound("outer", float(data[0]), i):
+                    self.OuterBoundUpdate(float(data[0]), i)
 
     def receive_innerbounds(self):
-        for i in self.innerbound_idx:
+        for i in list(self.innerbound_idx):
             data, wid = self.pairs[i].to_hub.read()
             if wid > self._spoke_read_ids[i]:
                 self._spoke_read_ids[i] = wid
+                if not self._accept_bound("inner", float(data[0]), i):
+                    continue
                 self.InnerBoundUpdate(float(data[0]), i)
                 sol = getattr(self.spokes[i], "best_solution", None)
                 if sol is not None and self.BestInnerBound == float(data[0]):
@@ -223,6 +281,15 @@ class Hub(SPCommunicator):
         self._drain_failures()
         self.receive_outerbounds()
         self.receive_innerbounds()
+        # surface nonzero spoke exits + their log tails (multiproc
+        # mode; collected by the supervisor) instead of discarding them
+        for rep in self.spoke_exit_reports:
+            how = "hung" if rep.get("hung") else f"rc={rep['rc']}"
+            tail = rep.get("log_tail") or ""
+            global_toc(
+                f"WARNING: spoke {rep['spoke']} ({rep['name']}) "
+                f"incarnation {rep['incarnation']} {how}"
+                + (f"; log tail:\n{tail}" if tail.strip() else ""))
         global_toc("Statistics at termination")
         self.print_init = True
         self.screen_trace()
@@ -245,6 +312,8 @@ class PHHub(Hub):
 
     def sync(self):
         self._drain_failures()
+        if self.supervisor is not None:
+            self.supervisor.poll()
         self.send_ws()
         self.send_nonants()
         if self.drive_spokes_inline:
@@ -314,6 +383,8 @@ class LShapedHub(Hub):
 
     def sync(self, send_nonants=True):
         self._drain_failures()
+        if self.supervisor is not None:
+            self.supervisor.poll()
         if send_nonants:
             self.send_nonants()
         if self.drive_spokes_inline:

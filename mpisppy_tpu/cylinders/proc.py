@@ -66,6 +66,7 @@ class SpokeHandle:
         self.converger_spoke_types = spoke_class.converger_spoke_types
         self.converger_spoke_char = spoke_class.converger_spoke_char
         self.provides_cuts = getattr(spoke_class, "provides_cuts", False)
+        self.spoke_name = spoke_class.__name__
         self._send_length = int(send_length)
         self._receive_length = int(receive_length)
         self._sol_path = sol_path
@@ -84,7 +85,13 @@ class SpokeHandle:
     @property
     def best_solution(self):
         if self._sol_path and os.path.exists(self._sol_path):
-            return np.load(self._sol_path)
+            # the spoke writes via tmp-file + os.replace, so the file
+            # is never torn; a malformed file (disk full, manual edit)
+            # degrades to "no solution" rather than crashing finalize
+            try:
+                return np.load(self._sol_path)
+            except (OSError, ValueError, EOFError):
+                return None
         return None
 
     def finalize(self):
@@ -152,7 +159,15 @@ def run_spoke_from_spec(specfile: str) -> int:
     spoke.main()
     sol = getattr(spoke, "best_solution", None)
     if sol is not None:
-        np.save(w["prefix"] + ".sol.npy", np.asarray(sol))
+        # atomic publish: the hub may read at any moment (spoke-exit
+        # re-pairing), so it must never observe a half-written file.
+        # np.save on a FILE OBJECT keeps the name verbatim (the path
+        # form would append .npy to the .tmp suffix).
+        final = w["prefix"] + ".sol.npy"
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            np.save(f, np.asarray(sol))
+        os.replace(tmp, final)
     spoke.finalize()
     return 0
 

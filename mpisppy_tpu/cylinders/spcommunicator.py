@@ -68,6 +68,11 @@ class Window:
         with self._lock:
             self._buf[-1] = self.KILL
 
+    def close(self):
+        """Interface parity with runtime.NativeWindow (which must
+        unmap its file): the in-memory window has nothing to release,
+        but callers may close any backend uniformly."""
+
 
 class WindowPair:
     """The two windows of one hub<->spoke stratum: hub-owned (spoke

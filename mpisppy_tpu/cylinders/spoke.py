@@ -19,6 +19,7 @@ import time
 
 import numpy as np
 
+from ..resilience.chaos import ChaosInjector
 from .spcommunicator import SPCommunicator, Window
 
 
@@ -38,15 +39,29 @@ class Spoke(SPCommunicator):
         self.pair = None           # WindowPair, set by the wheel
         self.last_hub_id = 0
         self._killed = False
+        # fault injection (resilience/chaos.py): inert unless the
+        # options carry a "chaos" dict or MPISPPY_TPU_CHAOS is set
+        self.chaos = ChaosInjector.from_options(
+            self.options.get("chaos"))
+        # liveness: the multiproc supervisor reads this spoke's to_hub
+        # write_id as its heartbeat; bound spokes re-post their current
+        # bound at this cadence so the id advances even when the bound
+        # has stopped improving
+        self.heartbeat_interval = float(
+            self.options.get("heartbeat_interval", 1.0))
+        self._last_heartbeat = 0.0
 
     # -- hub traffic (reference spoke.py:60-118) --------------------------
     def spoke_to_hub(self, values):
         """Post this spoke's vector (reference spoke.py:60)."""
+        values = self.chaos.poison(values)
+        self.chaos.pre_write()
         self.pair.to_hub.write(values)
 
     def spoke_from_hub(self):
         """(data, is_new): latest hub vector; is_new iff the write_id
         advanced since our last read (reference spoke.py:93-118)."""
+        self.chaos.step_tick()
         data, wid = self.pair.to_spoke.read()
         if wid == Window.KILL:
             self._killed = True
@@ -71,10 +86,22 @@ class Spoke(SPCommunicator):
         threaded loop backs off when a step was a no-op."""
         raise NotImplementedError
 
+    def _heartbeat(self):
+        """Keep the to_hub write_id advancing so the supervisor can
+        tell a slow spoke from a hung one; bound spokes override with
+        a real re-post, the base is a no-op."""
+
     def main(self):
         """Threaded-mode driver loop (reference: each spoke's main)."""
         while not self.got_kill_signal():
-            if self.get_serial_number() == 0 or not self.step():
+            did = False
+            if self.get_serial_number() != 0:
+                did = self.step()
+            now = time.time()
+            if now - self._last_heartbeat >= self.heartbeat_interval:
+                self._last_heartbeat = now
+                self._heartbeat()
+            if not did:
                 # nothing fresh from the hub yet — don't busy-spin
                 time.sleep(1e-3)
 
@@ -129,6 +156,13 @@ class _BoundSpoke(Spoke):
                     else candidate > self.bound)
         return (candidate > self.bound if self._is_inner_like()
                 else candidate < self.bound)
+
+    def _heartbeat(self):
+        """Re-post the current bound (same value, fresh write_id): the
+        hub's update is idempotent and the advancing id doubles as the
+        multiproc supervisor's liveness signal."""
+        if self._got_bound:
+            self.spoke_to_hub([self.bound])
 
     def _append_trace(self, value):
         """Reference spoke.py:204 _append_trace."""

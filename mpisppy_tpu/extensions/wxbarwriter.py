@@ -2,8 +2,13 @@
 mpisppy/utils/wxbarwriter.py:36-102 extension wrapper).
 
 Options (cfg group wxbar_read_write_args): options["W_fname"] — write
-an .npz checkpoint at every iteration (atomic-ish: last write wins) and
-at post_everything.
+an .npz checkpoint at every iteration (atomic: tmp file + os.replace)
+and at post_everything.
+
+For FULL crash-resumable checkpoints (the whole PHState plus hub
+bounds and incumbent, restored via options["resume_from"] or
+WheelSpinner(resume_from=...)), use options["run_checkpoint"] —
+see mpisppy_tpu/resilience/checkpoint.py and doc/src/resilience.md.
 """
 
 from __future__ import annotations

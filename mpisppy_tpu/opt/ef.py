@@ -105,7 +105,8 @@ class ExtensiveForm(SPOpt):
             cpu = jax.devices("cpu")[0]
         except RuntimeError:
             cpu = None
-        with jax.enable_x64():
+        from ..utils.platform import enable_x64_scope
+        with enable_x64_scope():
             put = ((lambda a: jax.device_put(np.asarray(a, np.float64),
                                              cpu))
                    if cpu is not None

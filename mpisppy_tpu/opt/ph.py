@@ -14,7 +14,17 @@ from ..phbase import PHBase
 class PH(PHBase):
     def ph_main(self, finalize=True):
         self.trivial_bound = None
-        trivial = self.Iter0()
+        # crash-resume: a checkpoint replaces Iter0 entirely (the full
+        # PHState — warm starts included — comes from the file, so the
+        # resumed trajectory replays the uninterrupted one); a missing
+        # file falls through to a fresh start, letting drivers pass
+        # resume_from unconditionally alongside run_checkpoint
+        resume = self.options.get("resume_from")
+        from ..resilience.checkpoint import checkpoint_exists
+        if resume is not None and checkpoint_exists(resume):
+            trivial = self.restore_run_checkpoint(resume)
+        else:
+            trivial = self.Iter0()
         self.iterk_loop()
         if finalize:
             eobj = self.post_loops()

@@ -28,6 +28,7 @@ import numpy as np
 
 from . import global_toc
 from .ir import ScenarioBatch, node_segment_sum
+from .resilience.chaos import ChaosInjector
 from .spopt import SPOpt
 from .utils import mfu as _mfu
 
@@ -201,6 +202,14 @@ class PHBase(SPOpt):
         if conv_cls is not None:
             self.convobject = conv_cls(self)
 
+        # crash-resume + fault injection (resilience/):
+        #   options["run_checkpoint"]   — atomic full-state checkpoint
+        #       path, written every options["checkpoint_every"] iters
+        #   options["resume_from"]      — checkpoint to restore instead
+        #       of running Iter0 (missing file => fresh start)
+        #   options["chaos"]            — deterministic fault injectors
+        self._chaos = ChaosInjector.from_options(self.options.get("chaos"))
+
     # -- hook plumbing (reference extensions/extension.py API) ------------
     def _ext(self, hook, *args):
         if self.extobject is not None:
@@ -352,12 +361,34 @@ class PHBase(SPOpt):
         self.conv = float(self.state.conv)
         return self.conv
 
+    # -- crash-resume (resilience/checkpoint.py) --------------------------
+    def _maybe_checkpoint(self, k):
+        path = self.options.get("run_checkpoint")
+        if not path:
+            return
+        if k % int(self.options.get("checkpoint_every", 1)) == 0:
+            from .resilience.checkpoint import save_run_checkpoint
+            save_run_checkpoint(path, self)
+
+    def restore_run_checkpoint(self, path):
+        """Install a full run checkpoint (state, bounds, iter) — the
+        Iter0 replacement on a `resume_from=` run."""
+        from .resilience.checkpoint import load_run_checkpoint
+        load_run_checkpoint(path, self)
+        global_toc(f"PH resumed from checkpoint {path} at iter "
+                   f"{int(self.state.it)} "
+                   f"(trivial_bound={self.trivial_bound})")
+        return self.trivial_bound
+
     # -- main loop (reference phbase.py:875-979 iterk_loop) ---------------
     def iterk_loop(self):
         max_iters = int(self.options.get("PHIterLimit", 100))
         convthresh = float(self.options.get("convthresh", 1e-4))
         verbose = self.options.get("verbose", False)
-        for k in range(1, max_iters + 1):
+        # a resumed run continues from the checkpointed iteration so
+        # the total iteration budget matches the uninterrupted run
+        start = int(self.state.it) if self.state is not None else 0
+        for k in range(start + 1, max_iters + 1):
             conv = self.ph_iteration()
             self._ext("miditer")
             if verbose or k % 10 == 0 or k == 1:
@@ -365,6 +396,10 @@ class PHBase(SPOpt):
                 global_toc(f"PH iter {k:4d} conv={conv:.6e} "
                            f"E[obj]={eobj:.6g}")
             self._ext("enditer")
+            self._maybe_checkpoint(k)
+            # chaos crash-at-iter fires AFTER the checkpoint: the test
+            # contract is "killed at iter k, resumable from iter k"
+            self._chaos.hub_iter_tick(k)
             if self.spcomm is not None:
                 self.spcomm.sync()
                 if self.spcomm.is_converged():

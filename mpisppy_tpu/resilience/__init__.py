@@ -1,0 +1,51 @@
+"""Resilience subsystem: spoke supervision, bound hygiene, crash
+checkpoints, and fault injection for the cylinder wheel.
+
+The reference mpi-sppy aborts the whole job when any MPI rank dies;
+this package is the graceful-degradation layer on top of the wheel:
+
+  * `supervisor.SpokeSupervisor` — multiproc-mode process supervision:
+    detects dead children (`Popen.poll`) and hung children (window
+    `write_id` staleness — the spoke's bound writes double as the
+    heartbeat), escalates SIGTERM -> SIGKILL with deadlines, restarts
+    from the declarative spec with capped exponential backoff, and
+    permanently prunes a spoke after its restart budget.
+  * `bounds.BoundGuard` — NaN/Inf and wrong-direction bound rejection
+    at the hub's window-read boundary, so a sick spoke degrades
+    instead of corrupting BestInnerBound/BestOuterBound.
+  * `checkpoint` — full atomic PH run checkpoints (W, xbar, x, y,
+    iter, best bounds, incumbent) with `resume_from=` on
+    PH/WheelSpinner.
+  * `chaos` — config/env-driven fault injectors (crash-at-step, hang,
+    NaN-bound, delayed window write, hub crash-at-iter) backing the
+    deterministic `chaos`-marked tests.
+
+See doc/src/resilience.md for the operator-facing story.
+"""
+
+from .bounds import BoundGuard
+from .chaos import ChaosError, ChaosInjector
+from .checkpoint import (checkpoint_exists, load_run_checkpoint,
+                         restore_hub, save_run_checkpoint)
+from .supervisor import SpokeSupervisor
+
+
+def wheel_counters(opt_or_hub):
+    """Resilience counters for benchmark/report JSON: works on a bare
+    optimizer (no wheel -> zeros), a Hub, or a WheelSpinner."""
+    hub = opt_or_hub
+    for attr in ("spcomm",):
+        hub = getattr(hub, attr, hub)
+    sup = getattr(hub, "supervisor", None)
+    failed = len(getattr(hub, "failed_spokes", ()) or ())
+    return {
+        "spoke_restarts": int(getattr(sup, "spoke_restarts", 0)),
+        "spokes_failed": failed,
+    }
+
+
+__all__ = [
+    "BoundGuard", "ChaosError", "ChaosInjector", "SpokeSupervisor",
+    "checkpoint_exists", "load_run_checkpoint", "restore_hub",
+    "save_run_checkpoint", "wheel_counters",
+]

@@ -1,0 +1,65 @@
+"""Bound hygiene at the hub's window-read boundary.
+
+A spoke's published bound travels through a shared-memory window with
+no schema beyond "one float64" — a sick spoke (numerical blow-up,
+memory corruption, chaos NaN injector) can post values that would
+silently corrupt BestInnerBound/BestOuterBound and with them the gap
+termination test.  The hub therefore screens every incoming bound:
+
+  * non-finite values (NaN/Inf) are rejected outright;
+  * wrong-direction values — an outer bound that crosses the current
+    best inner bound (or vice versa) beyond a relative tolerance —
+    are rejected, since a valid outer bound can never exceed a valid
+    incumbent (minimization; mirrored for maximization) by more than
+    solver noise.
+
+Rejections only increment a per-spoke counter and drop the message —
+the spoke keeps running and can recover — until the counter exceeds
+its budget, at which point the hub prunes the spoke through the same
+`_mark_spoke_failed` path a crashed spoke takes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class BoundGuard:
+    """Stateless validity check for one incoming scalar bound.
+
+    `rtol` scales the crossing tolerance by the magnitude of the bound
+    being compared against (floor 1.0), so legitimate eps-level
+    crossings from loose solves are never rejected while grossly
+    invalid bounds always are.
+    """
+
+    def __init__(self, rtol: float = 1e-2):
+        self.rtol = float(rtol)
+
+    def check(self, kind: str, value: float, inner: float, outer: float,
+              minimizing: bool):
+        """(ok, reason) for one incoming bound.
+
+        kind: "outer" or "inner"; inner/outer are the hub's current
+        best bounds (possibly +-inf before first update)."""
+        v = float(value)
+        if not np.isfinite(v):
+            return False, f"non-finite {kind} bound {v!r}"
+        other = inner if kind == "outer" else outer
+        if not np.isfinite(other):
+            return True, None
+        tol = self.rtol * max(1.0, abs(other))
+        # minimization: valid outer <= opt <= valid inner; a new outer
+        # above the incumbent (or inner below the outer bound) by more
+        # than tol means one side is corrupt — reject the newcomer
+        if minimizing:
+            crossed = (v > other + tol if kind == "outer"
+                       else v < other - tol)
+        else:
+            crossed = (v < other - tol if kind == "outer"
+                       else v > other + tol)
+        if crossed:
+            return False, (f"wrong-direction {kind} bound {v:.6g} "
+                           f"crosses best {'inner' if kind == 'outer' else 'outer'}"
+                           f" bound {other:.6g}")
+        return True, None

@@ -1,0 +1,116 @@
+"""Fault injection ("chaos") layer.
+
+Deterministic injectors that exercise every degradation path of the
+wheel end to end: a spoke that crashes (softly or via a hard
+`os._exit`, the SIGKILL stand-in), hangs, poisons its published bound
+with NaN, or delays its window writes; plus a hub-side crash-at-iter
+used by the checkpoint/resume tests.
+
+Configuration comes from the owner's options dict under the "chaos"
+key (JSON-serializable, so it crosses the multiproc spec boundary in
+`cylinders/proc.py` untouched), optionally overridden by the
+`MPISPPY_TPU_CHAOS` environment variable (a JSON dict — for manual
+chaos runs against an unmodified driver).
+
+Injection points (all no-ops when unconfigured):
+  * `Spoke.spoke_from_hub` calls `step_tick()` once per read — the
+    spoke-side step clock (crash_at_step / hang_at_step /
+    hard_exit).
+  * `Spoke.spoke_to_hub` routes outgoing vectors through
+    `poison()` (nan_bound) and `pre_write()` (delay_write_s).
+  * `PHBase.iterk_loop` calls `hub_iter_tick(k)` after the iter-k
+    checkpoint is written (crash_at_iter).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+ENV_VAR = "MPISPPY_TPU_CHAOS"
+
+
+class ChaosError(RuntimeError):
+    """An injected failure (never raised outside chaos runs)."""
+
+
+class ChaosInjector:
+    """One injector instance per owning cylinder; all state local.
+
+    Config keys (all optional):
+      crash_at_step: int   raise ChaosError on the N-th step tick
+      hard_exit: bool      crash via os._exit(13) instead of raising
+                           (no cleanup/atexit — the SIGKILL analog)
+      hang_at_step: int    stop making progress on the N-th tick
+                           (sleep loop; the process stays alive but
+                           its window writes go stale)
+      nan_bound: bool      replace every outgoing vector with NaN
+      delay_write_s: float sleep before every outgoing write
+      crash_at_iter: int   hub-side: raise ChaosError at PH iter N
+                           (after that iteration's checkpoint)
+    """
+
+    HARD_EXIT_CODE = 13
+
+    def __init__(self, config=None):
+        self.config = dict(config or {})
+        self.steps = 0
+
+    @classmethod
+    def from_options(cls, config=None):
+        """Merge the options-dict config with the env override (env
+        wins; an unset env and empty config yield an inert injector).
+        """
+        merged = dict(config or {})
+        env = os.environ.get(ENV_VAR)
+        if env:
+            try:
+                merged.update(json.loads(env))
+            except ValueError:
+                pass
+        return cls(merged)
+
+    @property
+    def active(self):
+        return bool(self.config)
+
+    # -- spoke-side -------------------------------------------------------
+    def step_tick(self):
+        """Advance the spoke step clock; crash or hang on schedule."""
+        if not self.config:
+            return
+        self.steps += 1
+        c = self.config
+        if c.get("hang_at_step") and self.steps >= int(c["hang_at_step"]):
+            # stay alive but stop all progress: the supervisor must
+            # notice via write_id staleness, not process death
+            while True:          # pragma: no cover - killed externally
+                time.sleep(0.25)
+        if c.get("crash_at_step") and self.steps >= int(c["crash_at_step"]):
+            if c.get("hard_exit"):
+                # no cleanup, no atexit, nonzero rc — the in-process
+                # stand-in for SIGKILL-ing the spoke
+                os._exit(self.HARD_EXIT_CODE)
+            raise ChaosError(
+                f"injected spoke crash at step {self.steps}")
+
+    def poison(self, values):
+        """NaN-poison an outgoing vector (bound hygiene tests)."""
+        if self.config.get("nan_bound"):
+            return np.full_like(np.asarray(values, np.float64), np.nan)
+        return values
+
+    def pre_write(self):
+        d = float(self.config.get("delay_write_s", 0) or 0)
+        if d > 0:
+            time.sleep(d)
+
+    # -- hub-side ---------------------------------------------------------
+    def hub_iter_tick(self, k):
+        """Crash the hub's PH loop at iteration k (checkpoint tests)."""
+        at = self.config.get("crash_at_iter")
+        if at is not None and int(k) == int(at):
+            raise ChaosError(f"injected hub crash at iter {k}")

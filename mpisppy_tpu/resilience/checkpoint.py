@@ -1,0 +1,134 @@
+"""Crash-resumable PH runs: full atomic run checkpoints.
+
+Extends the WXBarWriter W/xbar snapshot (`utils/wxbarutils.py`) into a
+complete PH run checkpoint: the whole `PHState` (x, y, W, xbar,
+xsqbar, obj, dual_obj, conv, it, solve_iters) plus the run-level
+scalars (trivial/best bound) and — when the optimizer runs under a
+hub — the hub's BestInnerBound/BestOuterBound and incumbent nonant
+solution.  Restoring the full state makes the resumed trajectory
+bit-replay the uninterrupted one (the superstep is deterministic in
+its state), so a run killed at iter k and resumed with `resume_from=`
+matches the uninterrupted run's W/xbar/bounds.
+
+Writes are atomic: the .npz is serialized to `<path>.tmp` and
+`os.replace`d over the target, so a reader (or a resume after a crash
+mid-write) never sees a torn file.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _norm_npz(path):
+    path = str(path)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def checkpoint_exists(path):
+    return os.path.exists(_norm_npz(path))
+
+
+def _opt_float(x):
+    """None -> nan for npz storage (and back, in _opt_load)."""
+    return np.float64(np.nan if x is None else float(x))
+
+
+def _opt_load(v):
+    v = float(v)
+    return None if np.isnan(v) else v
+
+
+def save_run_checkpoint(path, opt):
+    """Atomically persist the full run state of `opt` (a PHBase with a
+    live `state`); hub-level bounds ride along when `opt.spcomm` is a
+    hub."""
+    st = opt.state
+    if st is None:
+        raise RuntimeError("cannot checkpoint before Iter0 (no state)")
+    hub = getattr(opt, "spcomm", None)
+    incumbent = getattr(hub, "best_nonant_solution", None)
+    payload = {
+        "x": np.asarray(st.x), "y": np.asarray(st.y),
+        "W": np.asarray(st.W), "xbar": np.asarray(st.xbar),
+        "xsqbar": np.asarray(st.xsqbar),
+        "obj": np.asarray(st.obj), "dual_obj": np.asarray(st.dual_obj),
+        "conv": np.float64(st.conv), "it": np.int64(st.it),
+        "solve_iters": np.int64(st.solve_iters),
+        "trivial_bound": _opt_float(getattr(opt, "trivial_bound", None)),
+        "best_bound": _opt_float(getattr(opt, "best_bound", None)),
+        "nonant_names": (
+            np.array(opt.batch.tree.nonant_names, dtype=object)
+            if opt.batch.tree.nonant_names else np.array([], dtype=object)),
+        "best_inner": _opt_float(getattr(hub, "BestInnerBound", None)),
+        "best_outer": _opt_float(getattr(hub, "BestOuterBound", None)),
+        "incumbent": (np.asarray(incumbent) if incumbent is not None
+                      else np.array([])),
+    }
+    real = _norm_npz(path)
+    tmp = real + ".tmp"
+    # savez on a FILE OBJECT keeps the name verbatim (the path form
+    # appends .npz, which would break the replace pairing)
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **payload)
+    os.replace(tmp, real)
+    return real
+
+
+def load_run_checkpoint(path, opt):
+    """Install a saved run state into `opt` (shapes and nonant names
+    validated against its batch).  Returns the raw npz dict-like for
+    callers that want the hub-level fields too."""
+    import jax.numpy as jnp
+
+    from ..phbase import PHState
+
+    z = np.load(_norm_npz(path), allow_pickle=True)
+    b = opt.batch
+    S, K = b.num_scens, b.num_nonants
+    W = np.asarray(z["W"])
+    if W.shape != (S, K):
+        raise ValueError(
+            f"checkpoint W{W.shape} does not match this batch "
+            f"(S,K)=({S},{K})")
+    if np.asarray(z["x"]).shape[1] != b.num_vars:
+        raise ValueError(
+            f"checkpoint x has {np.asarray(z['x']).shape[1]} vars, "
+            f"batch has {b.num_vars}")
+    saved_names = tuple(np.asarray(z["nonant_names"]).tolist())
+    cur_names = tuple(b.tree.nonant_names or ())
+    if saved_names and cur_names and saved_names != cur_names:
+        raise ValueError(
+            "checkpoint nonant names do not match this model: "
+            f"{saved_names[:3]}... vs {cur_names[:3]}...")
+    dt = b.c.dtype
+    opt.state = PHState(
+        x=jnp.asarray(z["x"], dt), y=jnp.asarray(z["y"], dt),
+        W=jnp.asarray(W, dt), xbar=jnp.asarray(z["xbar"], dt),
+        xsqbar=jnp.asarray(z["xsqbar"], dt),
+        obj=jnp.asarray(z["obj"], dt),
+        dual_obj=jnp.asarray(z["dual_obj"], dt),
+        conv=jnp.asarray(float(z["conv"]), dt),
+        it=jnp.asarray(int(z["it"]), jnp.int32),
+        solve_iters=jnp.asarray(int(z["solve_iters"]), jnp.int32))
+    opt.conv = float(z["conv"])
+    opt.trivial_bound = _opt_load(z["trivial_bound"])
+    opt.best_bound = _opt_load(z["best_bound"])
+    return z
+
+
+def restore_hub(path, hub):
+    """Restore hub-level bound state (BestInner/OuterBound, incumbent)
+    from a run checkpoint — the hub half of `resume_from=`."""
+    z = np.load(_norm_npz(path), allow_pickle=True)
+    bi, bo = float(z["best_inner"]), float(z["best_outer"])
+    if np.isfinite(bi):
+        hub.BestInnerBound = bi
+    if np.isfinite(bo):
+        hub.BestOuterBound = bo
+    inc = np.asarray(z["incumbent"])
+    if inc.size:
+        hub.best_nonant_solution = inc
+    return hub

@@ -40,7 +40,13 @@ def _load():
             if (not os.path.exists(_LIB)
                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
                 _build()
-            lib = ctypes.CDLL(_LIB)
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError:
+                # a checked-out .so may target another toolchain/ABI;
+                # one rebuild from source is authoritative
+                _build()
+                lib = ctypes.CDLL(_LIB)
         except (OSError, subprocess.CalledProcessError):
             return None
         lib.exch_create.restype = ctypes.c_void_p

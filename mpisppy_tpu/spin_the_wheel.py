@@ -20,7 +20,6 @@ Dict schema mirrors the reference / vanilla factories:
 
 from __future__ import annotations
 
-import os
 import threading
 
 import numpy as np
@@ -30,7 +29,7 @@ from . import global_toc
 
 class WheelSpinner:
     def __init__(self, hub_dict, list_of_spoke_dict=(), mode="interleaved",
-                 keep_workdir=False):
+                 keep_workdir=False, resume_from=None):
         self._validate(hub_dict, list_of_spoke_dict)
         self.hub_dict = hub_dict
         self.list_of_spoke_dict = list(list_of_spoke_dict)
@@ -39,6 +38,25 @@ class WheelSpinner:
         self._ran = False
         # multiproc mode: keep the window/log tempdir for debugging
         self.options_keep_workdir = keep_workdir
+        # crash-resume (resilience/checkpoint.py): restore the hub
+        # optimizer's PH state AND the hub's best bounds/incumbent from
+        # a run checkpoint before spinning.  A missing file falls
+        # through to a fresh start, so drivers can pass the same path
+        # they write with options["run_checkpoint"] unconditionally.
+        self.resume_from = resume_from
+        if resume_from is not None:
+            kw = dict(self.hub_dict["opt_kwargs"])
+            kw["options"] = dict(kw.get("options") or {},
+                                 resume_from=resume_from)
+            self.hub_dict = dict(self.hub_dict, opt_kwargs=kw)
+
+    def _restore_hub_bounds(self, hub):
+        from .resilience.checkpoint import checkpoint_exists, restore_hub
+        if self.resume_from is not None \
+                and checkpoint_exists(self.resume_from):
+            restore_hub(self.resume_from, hub)
+            global_toc(f"WheelSpinner: hub bounds restored from "
+                       f"{self.resume_from}")
 
     @staticmethod
     def _validate(hub_dict, spoke_dicts):
@@ -88,6 +106,7 @@ class WheelSpinner:
             hub_opt, spokes,
             options=hd.get("hub_kwargs", {}).get("options"))
         hub.setup_hub()
+        self._restore_hub_bounds(hub)
         self.spcomm = hub
 
         if self.mode == "threads" and spokes:
@@ -163,7 +182,7 @@ class WheelSpinner:
         """
         import tempfile
 
-        from .cylinders.proc import SpokeHandle, spawn_spoke
+        from .cylinders.proc import SpokeHandle
 
         hd = self.hub_dict
         workdir = tempfile.mkdtemp(prefix="mpisppy_tpu_wheel_")
@@ -210,56 +229,33 @@ class WheelSpinner:
                          window_backend="native",
                          window_path_prefix=f"{workdir}/pair"))
         hub.setup_hub()       # creates + resets the window files
+        self._restore_hub_bounds(hub)
         self.spcomm = hub
 
-        procs = [spawn_spoke(spec, workdir, str(i))
-                 for i, spec in enumerate(specs)]
-        for h, p in zip(handles, procs):
-            h.proc = p
-
-        killed_by_us = set()
-
-        def check_children():
-            """Fail fast when a spoke process died (bad spec, import
-            error, window mismatch) instead of spinning the hub with
-            no incoming bounds.  Processes WE killed (slow to notice
-            the kill signal after a successful run) are not failures."""
-            for i, p in enumerate(procs):
-                rc = p.poll()
-                if rc is not None and rc != 0 and i not in killed_by_us:
-                    tail = ""
-                    lp = getattr(p, "log_path", None)
-                    if lp and os.path.exists(lp):
-                        with open(lp) as f:
-                            tail = "".join(f.readlines()[-15:])
-                    raise RuntimeError(
-                        f"spoke process {i} exited rc={rc}; log tail:\n"
-                        f"{tail}")
+        # supervision (resilience/supervisor.py): spawns the children,
+        # then — polled from hub.sync() every iteration — detects dead
+        # (Popen.poll) and hung (stale window write_id) spokes,
+        # restarts them from the spec with capped backoff, and prunes
+        # them into _mark_spoke_failed once the restart budget is
+        # spent.  The wheel always finishes: worst case hub-only.
+        from .resilience.supervisor import SpokeSupervisor
+        sup = SpokeSupervisor(hub, specs, workdir,
+                              options=hub.options)
+        hub.supervisor = sup
+        sup.start()
 
         hub.drive_spokes_inline = False
         ok = False
         try:
-            import time as _time
-            _time.sleep(0.5)        # catch immediate startup crashes
-            check_children()
             hub.main()
-            check_children()
+            sup.poll(force=True)   # catch deaths after the last sync
             hub.send_terminate()
-            for i, p in enumerate(procs):
-                try:
-                    p.wait(timeout=120)
-                except Exception:
-                    global_toc(f"spoke {i} still busy 120s after the "
-                               "kill signal; terminating it")
-                    killed_by_us.add(i)
-                    p.kill()
-            check_children()
+            sup.shutdown(timeout=float(hub.options.get(
+                "shutdown_join_timeout", 120.0)))
             ok = True
         finally:
-            for i, p in enumerate(procs):
-                if p.poll() is None:
-                    killed_by_us.add(i)
-                    p.kill()
+            sup.kill_all()
+        hub.spoke_exit_reports = sup.exit_reports
         hub.hub_finalize()
         # incumbent pairing: a spoke process writes its solution file
         # only at finalize (after the kill), long after the hub read the
@@ -271,14 +267,20 @@ class WheelSpinner:
             if (wid > 0 and sol is not None
                     and float(data[0]) == hub.BestInnerBound):
                 hub.best_nonant_solution = sol
-        if ok and not self.options_keep_workdir:
-            # mmap windows/logs are debugging artifacts; clean on
-            # success, keep on failure (the raise above skips this)
+        if ok and not self.options_keep_workdir \
+                and not sup.exit_reports:
+            # mmap windows/logs are debugging artifacts; clean on a
+            # fully healthy run, keep whenever any spoke died/hung (the
+            # logs are the post-mortem) or on failure (the raise above
+            # skips this)
             import shutil
             for pair in hub.pairs:
                 pair.to_spoke.close()
                 pair.to_hub.close()
             shutil.rmtree(workdir, ignore_errors=True)
+        elif ok and sup.exit_reports:
+            global_toc(f"WheelSpinner[multiproc]: spoke failure logs "
+                       f"kept in {workdir}")
         self._ran = True
         return self
 

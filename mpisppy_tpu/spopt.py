@@ -245,7 +245,8 @@ class SPOpt(SPBase):
             cpu = jax.devices("cpu")[0]
         except RuntimeError:
             cpu = None
-        with jax.enable_x64():
+        from .utils.platform import enable_x64_scope
+        with enable_x64_scope():
             put = ((lambda a: jax.device_put(a, cpu))
                    if cpu is not None else jnp.asarray)
             full = self._np_cache.get(prep_key)

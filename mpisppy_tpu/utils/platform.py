@@ -46,6 +46,20 @@ def enable_compile_cache_if_cpu():
         pass
 
 
+def enable_x64_scope():
+    """Version-tolerant `with ... :` scope forcing x64 semantics: jax
+    exports the context manager as `jax.enable_x64` in newer releases
+    and as `jax.experimental.enable_x64` in older ones; the f64
+    certification paths (spopt certify, ef dual bound) must work on
+    both."""
+    import jax
+
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:
+        from jax.experimental import enable_x64 as ctx
+    return ctx()
+
+
 def enable_f64_if_cpu():
     """The project-wide precision protocol: device=cpu always means
     f64 (certified-eps paths — MIP diving at 1e-6, golden drives — are

@@ -10,6 +10,7 @@ file format (rows: scenario, varname, value).
 from __future__ import annotations
 
 import csv
+import os
 
 import numpy as np
 
@@ -23,14 +24,23 @@ def _norm_npz(path):
 
 def write_W_and_xbar(path, opt):
     """Persist the current PH dual state (reference ROOT usage:
-    WXBarWriter extension)."""
+    WXBarWriter extension).  Atomic: written to a tmp file and
+    os.replace'd, so a reader — or a resume after a crash mid-write —
+    never sees a torn checkpoint."""
     st = opt.state
-    np.savez_compressed(
-        _norm_npz(path),
-        W=np.asarray(st.W), xbar=np.asarray(st.xbar),
-        nonant_names=np.array(opt.batch.tree.nonant_names, dtype=object)
-        if opt.batch.tree.nonant_names else np.array([], dtype=object),
-        it=int(st.it))
+    real = _norm_npz(path)
+    tmp = real + ".tmp"
+    # savez on a FILE OBJECT keeps the name verbatim (the path form
+    # would append .npz to the .tmp suffix)
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f,
+            W=np.asarray(st.W), xbar=np.asarray(st.xbar),
+            nonant_names=np.array(opt.batch.tree.nonant_names,
+                                  dtype=object)
+            if opt.batch.tree.nonant_names else np.array([], dtype=object),
+            it=int(st.it))
+    os.replace(tmp, real)
 
 
 def read_W_and_xbar(path, opt):

@@ -22,3 +22,12 @@ ensure_cpu_backend(force=True)
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 "
+        "`-m 'not slow'` selection")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection tests "
+        "(resilience layer); these RUN under tier-1's `-m 'not slow'`")

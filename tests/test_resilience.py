@@ -1,0 +1,504 @@
+"""Resilience-layer tests: bound hygiene, chaos (fault injection),
+spoke supervision, and crash-resumable runs.
+
+Every failure here is INJECTED deterministically through
+mpisppy_tpu/resilience/chaos.py — no timing-dependent flakiness in the
+failure itself (detection latencies are bounded by tiny supervision
+intervals).  The `chaos` marker keeps these selectable; they run under
+tier-1's `-m 'not slow'`.
+"""
+
+import os
+import time
+import types
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu.cylinders.hub import PHHub
+from mpisppy_tpu.cylinders.lagrangian_bounder import LagrangianOuterBound
+from mpisppy_tpu.cylinders.proc import SpokeHandle
+from mpisppy_tpu.cylinders.spcommunicator import Window
+from mpisppy_tpu.cylinders.xhatshufflelooper_bounder import (
+    XhatShuffleInnerBound)
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.opt.ph import PH
+from mpisppy_tpu.resilience import wheel_counters
+from mpisppy_tpu.resilience.bounds import BoundGuard
+from mpisppy_tpu.resilience.chaos import ChaosError, ChaosInjector
+from mpisppy_tpu.resilience.checkpoint import (
+    checkpoint_exists, load_run_checkpoint, restore_hub,
+    save_run_checkpoint)
+from mpisppy_tpu.resilience.supervisor import SpokeSupervisor
+from mpisppy_tpu.runtime import native
+from mpisppy_tpu.spin_the_wheel import WheelSpinner
+from mpisppy_tpu.utils.xhat_eval import Xhat_Eval
+
+OPTS = {"defaultPHrho": 1.0, "PHIterLimit": 40, "convthresh": 0.0,
+        "pdhg_eps": 1e-7, "pdhg_max_iters": 20000}
+S = 3
+NAMES = [f"scen{i}" for i in range(S)]
+
+
+def farmer_wheel(spoke_specs, mode="interleaved", hub_opts=None,
+                 opt_overrides=None, **ws_kwargs):
+    """spoke_specs: (spoke_class, opt_class, spoke_options) triples."""
+    b = farmer.build_batch(S)
+    opts = {**OPTS, **(opt_overrides or {})}
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 1e-4, "abs_gap": 1.0,
+                                   **(hub_opts or {})}},
+        "opt_class": PH,
+        "opt_kwargs": {"options": opts, "all_scenario_names": NAMES,
+                       "batch": b},
+    }
+    spoke_dicts = [
+        {"spoke_class": cls, "spoke_kwargs": {"options": sp_opts or {}},
+         "opt_class": opt_cls,
+         "opt_kwargs": {"options": dict(opts),
+                        "all_scenario_names": NAMES}}
+        for cls, opt_cls, sp_opts in spoke_specs]
+    return WheelSpinner(hub_dict, spoke_dicts, mode=mode, **ws_kwargs)
+
+
+class TestBoundGuard:
+    """Unit coverage of the window-read hygiene rules."""
+
+    def test_rejects_non_finite(self):
+        g = BoundGuard()
+        for bad in (np.nan, np.inf, -np.inf):
+            ok, reason = g.check("outer", bad, inner=-100.0, outer=-200.0,
+                                 minimizing=True)
+            assert not ok and "non-finite" in reason
+
+    def test_rejects_wrong_direction_minimizing(self):
+        g = BoundGuard(rtol=1e-2)
+        # an outer bound ABOVE the incumbent by >1% is corrupt
+        ok, reason = g.check("outer", -100.0, inner=-108390.0,
+                             outer=-np.inf, minimizing=True)
+        assert not ok and "wrong-direction" in reason
+        # an inner bound BELOW the outer bound by >1% is corrupt
+        ok, reason = g.check("inner", -200000.0, inner=np.inf,
+                             outer=-108390.0, minimizing=True)
+        assert not ok
+
+    def test_accepts_valid_and_eps_crossings(self):
+        g = BoundGuard(rtol=1e-2)
+        assert g.check("outer", -108500.0, inner=-108390.0,
+                       outer=-np.inf, minimizing=True)[0]
+        # eps-level crossing from a loose solve stays within rtol
+        assert g.check("outer", -108389.0, inner=-108390.0,
+                       outer=-np.inf, minimizing=True)[0]
+        # nothing to compare against yet -> accept
+        assert g.check("outer", -1e9, inner=np.inf, outer=-np.inf,
+                       minimizing=True)[0]
+
+    def test_maximizing_mirrored(self):
+        g = BoundGuard(rtol=1e-2)
+        ok, _ = g.check("outer", 50.0, inner=100.0, outer=np.inf,
+                        minimizing=False)
+        assert not ok
+        assert g.check("outer", 150.0, inner=100.0, outer=np.inf,
+                       minimizing=False)[0]
+
+
+class TestChaosInjector:
+    def test_inert_by_default(self):
+        c = ChaosInjector()
+        assert not c.active
+        c.step_tick()
+        v = np.array([1.0, 2.0])
+        assert c.poison(v) is v
+        c.hub_iter_tick(10**9)
+
+    def test_env_override_merges(self, monkeypatch):
+        monkeypatch.setenv("MPISPPY_TPU_CHAOS",
+                           '{"crash_at_step": 7}')
+        c = ChaosInjector.from_options({"nan_bound": True})
+        assert c.config["crash_at_step"] == 7
+        assert c.config["nan_bound"] is True
+        monkeypatch.setenv("MPISPPY_TPU_CHAOS", "not json")
+        assert ChaosInjector.from_options({"a": 1}).config == {"a": 1}
+
+    def test_crash_and_poison(self):
+        c = ChaosInjector({"crash_at_step": 2})
+        c.step_tick()
+        with pytest.raises(ChaosError):
+            c.step_tick()
+        p = ChaosInjector({"nan_bound": True}).poison([1.0, 2.0])
+        assert np.isnan(p).all()
+
+    def test_hub_crash_at_iter(self):
+        c = ChaosInjector({"crash_at_iter": 3})
+        c.hub_iter_tick(2)
+        with pytest.raises(ChaosError):
+            c.hub_iter_tick(3)
+
+
+@pytest.mark.chaos
+class TestBoundHygieneWheel:
+    def test_nan_bound_spoke_rejected_then_pruned(self):
+        """A spoke whose published bounds are NaN-poisoned never
+        corrupts Best*Bound: every message is rejected at the window
+        read, the rejection counter grows, and past the budget the
+        spoke is pruned like a crashed one — while the healthy inner
+        spoke and the hub's own trivial bound still close the run."""
+        ws = farmer_wheel(
+            [(LagrangianOuterBound, PH, {"chaos": {"nan_bound": True}}),
+             (XhatShuffleInnerBound, Xhat_Eval, None)],
+            hub_opts={"max_bound_rejects": 3})
+        ws.spin()
+        hub = ws.spcomm
+        assert int(hub.bound_rejects[0]) >= 3
+        assert len(hub.failed_spokes) == 1
+        assert "rejected bounds" in hub.failed_spokes[0][1]
+        # the poison never reached the bound state
+        assert np.isfinite(ws.BestOuterBound)
+        assert np.isfinite(ws.BestInnerBound)
+        assert abs(ws.BestInnerBound - -108390.0) < 50.0
+        assert wheel_counters(ws.spcomm) == {"spoke_restarts": 0,
+                                             "spokes_failed": 1}
+
+    def test_threaded_chaos_crash_pruned(self):
+        """Threaded mode: an injected ChaosError inside the spoke's
+        step is reported from the spoke thread and pruned on the hub
+        thread; the wheel finishes with valid bounds."""
+        # crash on the FIRST step tick: the tick fires in
+        # spoke_from_hub (before the expensive compiled solve), so the
+        # crash lands while the hub is still iterating no matter how
+        # the thread schedules around the hub's fast PH loop
+        ws = farmer_wheel(
+            [(LagrangianOuterBound, PH,
+              {"chaos": {"crash_at_step": 1}}),
+             (XhatShuffleInnerBound, Xhat_Eval, None)],
+            mode="threads")
+        ws.spin()
+        hub = ws.spcomm
+        assert len(hub.failed_spokes) == 1
+        assert hub.failed_spokes[0][0] == "LagrangianOuterBound"
+        assert "injected spoke crash" in hub.failed_spokes[0][1]
+        assert np.isfinite(ws.BestInnerBound)
+        assert np.isfinite(ws.BestOuterBound)
+        assert abs(ws.BestInnerBound - -108390.0) < 50.0
+
+
+def _fake_hub(n):
+    hub = types.SimpleNamespace(
+        spokes=[types.SimpleNamespace(proc=None, spoke_name=f"Spoke{i}")
+                for i in range(n)],
+        pairs=[types.SimpleNamespace(to_hub=Window(1)) for _ in range(n)],
+        failed=[])
+    hub._mark_spoke_failed = lambda i, exc: hub.failed.append((i, str(exc)))
+    return hub
+
+
+def _sleeper_spawn(spec, workdir, tag):
+    import subprocess
+    import sys
+    p = subprocess.Popen([sys.executable, "-c",
+                          "import time; time.sleep(60)"])
+    return p
+
+
+class TestSupervisorUnit:
+    """Supervisor mechanics against an injected spawn_fn — no JAX child
+    processes, so hang detection and the restart/prune ladder are
+    exercised in seconds."""
+
+    def _drive(self, sup, hub, until, timeout=30.0):
+        t0 = time.monotonic()
+        while not until() and time.monotonic() - t0 < timeout:
+            sup.poll(force=True)
+            time.sleep(0.02)
+        assert until(), "supervisor never reached the expected state"
+
+    def test_hang_detected_restarted_then_pruned(self):
+        hub = _fake_hub(1)
+        sup = SpokeSupervisor(
+            hub, specs=[{}], workdir=".", spawn_fn=_sleeper_spawn,
+            options={"supervise_interval": 0.0,
+                     "spoke_hang_timeout": 0.3,
+                     "spoke_max_restarts": 1,
+                     "spoke_restart_backoff": 0.01,
+                     "spoke_term_deadline": 2.0})
+        sup.start()
+        try:
+            first_pid = hub.spokes[0].proc.pid
+            # incarnation 0 never writes -> hung -> killed -> restarted
+            self._drive(sup, hub, lambda: sup.restarts[0] == 1)
+            assert sup.spoke_restarts == 1
+            # incarnation 1 hangs too -> budget exhausted -> pruned
+            self._drive(sup, hub, lambda: sup.spokes_failed == 1)
+            assert hub.failed and hub.failed[0][0] == 0
+            assert "hung" in hub.failed[0][1]
+            assert all(r["hung"] for r in sup.exit_reports)
+            assert len(sup.exit_reports) == 2
+            # both incarnations are really dead
+            assert hub.spokes[0].proc.poll() is not None
+            assert first_pid in sup.killed_by_us
+        finally:
+            sup.kill_all()
+
+    def test_window_writes_defer_hang_verdict(self):
+        """A spoke whose write_id keeps advancing is NEVER declared
+        hung, no matter how long it runs."""
+        hub = _fake_hub(1)
+        sup = SpokeSupervisor(
+            hub, specs=[{}], workdir=".", spawn_fn=_sleeper_spawn,
+            options={"supervise_interval": 0.0,
+                     "spoke_hang_timeout": 0.2,
+                     "spoke_max_restarts": 0})
+        sup.start()
+        try:
+            for _ in range(10):
+                hub.pairs[0].to_hub.write([1.0])   # heartbeat analog
+                sup.poll(force=True)
+                time.sleep(0.05)
+            assert sup.spokes_failed == 0 and not hub.failed
+        finally:
+            sup.kill_all()
+
+    def test_clean_exit_is_not_a_failure(self):
+        hub = _fake_hub(1)
+
+        def quick_spawn(spec, workdir, tag):
+            import subprocess
+            import sys
+            return subprocess.Popen([sys.executable, "-c", "pass"])
+
+        sup = SpokeSupervisor(hub, specs=[{}], workdir=".",
+                              spawn_fn=quick_spawn,
+                              options={"supervise_interval": 0.0})
+        sup.start()
+        hub.spokes[0].proc.wait(timeout=30)
+        sup.poll(force=True)
+        assert sup.state[0] == "stopped"
+        assert sup.spokes_failed == 0 and not sup.exit_reports
+
+
+class TestAtomicSolutionFile:
+    def test_malformed_sol_file_degrades_to_none(self, tmp_path):
+        p = tmp_path / "pair0.sol.npy"
+        p.write_bytes(b"\x93NUMPY garbage not a real file")
+        h = SpokeHandle(LagrangianOuterBound, 1, 1, sol_path=str(p))
+        assert h.best_solution is None
+
+    def test_missing_sol_file(self, tmp_path):
+        h = SpokeHandle(LagrangianOuterBound, 1, 1,
+                        sol_path=str(tmp_path / "nope.sol.npy"))
+        assert h.best_solution is None
+
+
+@pytest.mark.chaos
+class TestCheckpointResume:
+    def _ph(self, extra=None):
+        b = farmer.build_batch(S)
+        opts = {**OPTS, "PHIterLimit": 8, **(extra or {})}
+        return PH(opts, NAMES, batch=b)
+
+    def test_crash_at_iter_then_resume_replays(self, tmp_path):
+        """A run killed at iter 4 (chaos, AFTER that iteration's
+        checkpoint) and resumed from the checkpoint lands on the same
+        W/xbar/conv as the uninterrupted run — full-PHState restore
+        makes the resumed trajectory a bit-replay."""
+        ck = str(tmp_path / "run.ckpt")
+        ph_a = self._ph()
+        conv_a, _, triv_a = ph_a.ph_main(finalize=False)
+
+        ph_b = self._ph({"run_checkpoint": ck,
+                         "chaos": {"crash_at_iter": 4}})
+        with pytest.raises(ChaosError):
+            ph_b.ph_main(finalize=False)
+        assert checkpoint_exists(ck)
+        assert int(np.load(ck + ".npz")["it"]) == 4
+
+        ph_c = self._ph({"resume_from": ck})
+        conv_c, _, triv_c = ph_c.ph_main(finalize=False)
+        assert int(ph_c.state.it) == int(ph_a.state.it) == 8
+        assert triv_c == pytest.approx(triv_a)
+        assert conv_c == pytest.approx(conv_a, rel=1e-8, abs=1e-12)
+        np.testing.assert_allclose(np.asarray(ph_c.state.W),
+                                   np.asarray(ph_a.state.W),
+                                   rtol=1e-9, atol=1e-9)
+        np.testing.assert_allclose(np.asarray(ph_c.state.xbar),
+                                   np.asarray(ph_a.state.xbar),
+                                   rtol=1e-9, atol=1e-9)
+
+    def test_missing_checkpoint_falls_through_to_fresh(self, tmp_path):
+        ph = self._ph({"resume_from": str(tmp_path / "absent.ckpt")})
+        conv, _, triv = ph.ph_main(finalize=False)
+        assert np.isfinite(triv) and int(ph.state.it) == 8
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        ck = str(tmp_path / "bad.ckpt")
+        ph = self._ph({"PHIterLimit": 1})
+        ph.ph_main(finalize=False)
+        save_run_checkpoint(ck, ph)
+        # different nonant count (device padding can make two scenario
+        # counts agree, so vary K, not S)
+        other = PH(dict(OPTS, PHIterLimit=1), NAMES,
+                   batch=farmer.build_batch(S, crops_multiplier=2))
+        other.ph_main(finalize=False)
+        with pytest.raises(ValueError, match="does not match"):
+            load_run_checkpoint(ck, other)
+
+    def test_atomic_write_no_torn_tmp(self, tmp_path):
+        ck = str(tmp_path / "atomic.ckpt")
+        ph = self._ph({"PHIterLimit": 1})
+        ph.ph_main(finalize=False)
+        real = save_run_checkpoint(ck, ph)
+        assert os.path.exists(real)
+        assert not os.path.exists(real + ".tmp")
+
+    def test_wheel_resume_restores_hub_bounds(self, tmp_path):
+        """WheelSpinner(resume_from=...) restores BestInner/OuterBound
+        and the incumbent, not just the optimizer state."""
+        ck = str(tmp_path / "wheel.ckpt")
+        ws_a = farmer_wheel(
+            [(XhatShuffleInnerBound, Xhat_Eval, None)],
+            opt_overrides={"PHIterLimit": 6, "run_checkpoint": ck})
+        ws_a.spin()
+        assert checkpoint_exists(ck)
+        # resumed wheel: checkpointed iter == PHIterLimit, so zero new
+        # iterations — every bound it reports came from the checkpoint
+        ws_b = farmer_wheel([], opt_overrides={"PHIterLimit": 6},
+                            resume_from=ck)
+        ws_b.spin()
+        assert ws_b.BestOuterBound == pytest.approx(ws_a.BestOuterBound)
+        if np.isfinite(ws_a.BestInnerBound):
+            assert ws_b.BestInnerBound == pytest.approx(
+                ws_a.BestInnerBound)
+        sol_a, sol_b = ws_a.best_nonant_solution(), \
+            ws_b.best_nonant_solution()
+        assert sol_b is not None
+        np.testing.assert_allclose(np.asarray(sol_b), np.asarray(sol_a),
+                                   rtol=1e-9, atol=1e-9)
+
+    def test_restore_hub_unit(self, tmp_path):
+        ck = str(tmp_path / "hubside.ckpt")
+        ph = self._ph({"PHIterLimit": 1})
+        ph.ph_main(finalize=False)
+        ph.spcomm = types.SimpleNamespace(
+            BestInnerBound=-108000.0, BestOuterBound=-109000.0,
+            best_nonant_solution=np.array([1.0, 2.0, 3.0]))
+        save_run_checkpoint(ck, ph)
+        fresh = types.SimpleNamespace(BestInnerBound=np.inf,
+                                      BestOuterBound=-np.inf,
+                                      best_nonant_solution=None)
+        restore_hub(ck, fresh)
+        assert fresh.BestInnerBound == -108000.0
+        assert fresh.BestOuterBound == -109000.0
+        np.testing.assert_array_equal(fresh.best_nonant_solution,
+                                      [1.0, 2.0, 3.0])
+
+
+class _SupervisedChaosHub(PHHub):
+    """Test hub: spins until the supervisor has pruned a spoke (or a
+    wall-clock safety valve), so the PH loop deterministically outlives
+    the spawn -> crash -> restart -> crash -> prune sequence regardless
+    of child JAX start-up time."""
+
+    WALL_LIMIT_S = 240.0
+
+    def setup_hub(self):
+        super().setup_hub()
+        self._t0 = time.monotonic()
+
+    def is_converged(self):
+        super().is_converged()          # seeds the trivial outer bound
+        if self.supervisor is not None and self.supervisor.spokes_failed:
+            return True
+        # keep the loop cheap while waiting on child process lifecycles
+        time.sleep(0.02)
+        return time.monotonic() - self._t0 > self.WALL_LIMIT_S
+
+
+@pytest.mark.chaos
+@pytest.mark.skipif(not native.available(),
+                    reason="native exchange library unavailable")
+def test_multiproc_crashed_spoke_restarted_then_pruned():
+    """End-to-end multiproc supervision: a spoke process that hard-exits
+    (os._exit, the SIGKILL stand-in — no cleanup, no goodbye) is
+    detected via Popen.poll, restarted once from its declarative spec,
+    and permanently pruned when the second incarnation dies too; the
+    hub finishes on its own valid bounds and surfaces both exits (code
+    + log tail) in its final report."""
+    b = farmer.build_batch(S)
+    batch_spec = {"module": "mpisppy_tpu.models.farmer",
+                  "builder": "build_batch",
+                  "kwargs": {"num_scens": S}}
+    chaos = {"crash_at_step": 3, "hard_exit": True}
+    hub_dict = {
+        "hub_class": _SupervisedChaosHub,
+        "hub_kwargs": {"options": {
+            "supervise_interval": 0.05,
+            "spoke_max_restarts": 1,
+            "spoke_restart_backoff": 0.1,
+            "shutdown_join_timeout": 30.0}},
+        "opt_class": PH,
+        "opt_kwargs": {"options": dict(OPTS, PHIterLimit=10**6),
+                       "all_scenario_names": NAMES, "batch": b},
+    }
+    spoke_dicts = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PH,
+         "spoke_kwargs": {"options": {"chaos": chaos}},
+         "opt_kwargs": {"options": dict(OPTS),
+                        "all_scenario_names": NAMES},
+         "proc": {"batch": batch_spec}},
+    ]
+    ws = WheelSpinner(hub_dict, spoke_dicts, mode="multiproc").spin()
+    hub = ws.spcomm
+    sup = hub.supervisor
+    assert sup.spoke_restarts == 1, "spoke was not restarted exactly once"
+    assert sup.spokes_failed == 1, "spoke was not pruned after the budget"
+    assert len(hub.failed_spokes) == 1
+    assert hub.failed_spokes[0][0] == "LagrangianOuterBound"
+    # both incarnations' exits were recorded with the chaos exit code
+    assert len(sup.exit_reports) == 2
+    assert [r["rc"] for r in sup.exit_reports] == [13, 13]
+    assert [r["incarnation"] for r in sup.exit_reports] == [0, 1]
+    assert hub.spoke_exit_reports is sup.exit_reports
+    # the wheel still ends with the hub's own valid outer bound
+    assert np.isfinite(ws.BestOuterBound)
+    assert ws.BestOuterBound <= -108000.0
+    assert wheel_counters(ws) == {"spoke_restarts": 1, "spokes_failed": 1}
+
+
+@pytest.mark.chaos
+@pytest.mark.skipif(not native.available(),
+                    reason="native exchange library unavailable")
+def test_multiproc_healthy_run_counters_zero():
+    """Supervised healthy multiproc run: delayed window writes (chaos
+    delay injector) are tolerated, counters stay zero, children exit
+    rc=0 on the kill signal, and the bounds still bracket."""
+    b = farmer.build_batch(S)
+    batch_spec = {"module": "mpisppy_tpu.models.farmer",
+                  "builder": "build_batch",
+                  "kwargs": {"num_scens": S}}
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 1e-4,
+                                   "supervise_interval": 0.1,
+                                   "shutdown_join_timeout": 60.0}},
+        "opt_class": PH,
+        "opt_kwargs": {"options": dict(OPTS, PHIterLimit=25),
+                       "all_scenario_names": NAMES, "batch": b},
+    }
+    spoke_dicts = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PH,
+         "spoke_kwargs": {"options": {
+             "chaos": {"delay_write_s": 0.01},
+             "heartbeat_interval": 0.2}},
+         "opt_kwargs": {"options": dict(OPTS),
+                        "all_scenario_names": NAMES},
+         "proc": {"batch": batch_spec}},
+    ]
+    ws = WheelSpinner(hub_dict, spoke_dicts, mode="multiproc").spin()
+    hub = ws.spcomm
+    for h in hub.spokes:
+        assert h.proc is not None and h.proc.returncode == 0
+    assert wheel_counters(ws) == {"spoke_restarts": 0, "spokes_failed": 0}
+    assert not hub.supervisor.exit_reports
+    assert np.isfinite(ws.BestOuterBound)
+    assert ws.BestOuterBound <= -108389.0
