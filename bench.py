@@ -128,6 +128,39 @@ def _run_worker(extra_env, timeout_s):
     return None
 
 
+def _telemetry_extras(ph, profile_iters=2):
+    """Phase-timing breakdown + window-traffic counters for the BENCH
+    JSON (telemetry subsystem).  Runs OUTSIDE the timed window: after
+    the measurement, a few extra PH iterations execute under the
+    phased (unfused) superstep to attribute time to
+    solve / xbar-psum / W-update / convergence.  BENCH_PHASES=0 skips
+    the profile pass (e.g. when the phase-jit compiles would not fit
+    the remaining budget); the traffic counters are reported either
+    way (zeros for a bench run without a wheel)."""
+    from mpisppy_tpu import telemetry
+
+    out = {"window_traffic": telemetry.traffic_counters()}
+    if os.environ.get("BENCH_PHASES", "1") == "0":
+        return out
+    prev = telemetry._active
+    tel = telemetry.configure({"enabled": True, "phase_timing": True})
+    saved_tel = ph._tel
+    ph._tel = tel
+    try:
+        for _ in range(profile_iters):
+            ph.ph_iteration()
+        hists = ph._tel.registry.snapshot()["histograms"]
+        out["phase_seconds"] = {
+            k: round(hists[f"ph.phase.{k}_seconds"]["mean"], 6)
+            for k in ("solve", "psum", "w_update", "conv")
+            if hists.get(f"ph.phase.{k}_seconds", {}).get("mean")
+            is not None}
+    finally:
+        ph._tel = saved_tel
+        telemetry._active = prev
+    return out
+
+
 def worker_sslp():
     """BENCH_MODEL=sslp50: the BASELINE target row "sslp, 50-100 scen
     (LP relaxation) — same gap" (BASELINE.md; the reference publishes
@@ -340,7 +373,8 @@ def worker_uc():
         "iter0_feas_mass": round(
             getattr(ph, "iter0_feas_mass", 1.0), 4),
         "shared_A": bool(b.shared_A),
-        **wheel_counters(ph)}))
+        **wheel_counters(ph),
+        **_telemetry_extras(ph)}))
 
 
 def worker():
@@ -470,6 +504,7 @@ def worker():
         "certify_frac": round(stats["certify_wall_s"] / max(wall, 1e-9),
                               4),
     }
+    extra.update(_telemetry_extras(ph))
     if fallback_sized:
         extra["note_size"] = ("accelerator unavailable: CPU fallback "
                               f"at S={S} (f64)")

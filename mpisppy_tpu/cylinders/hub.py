@@ -69,6 +69,10 @@ class Hub(SPCommunicator):
             if self.options.get("bound_guard", True) else None)
         self._max_bound_rejects = int(
             self.options.get("max_bound_rejects", 25))
+        # bound-progression + reject telemetry (null no-ops when off)
+        self._c_rejects = self.telemetry.counter("window.bound_rejects")
+        self._g_outer = self.telemetry.gauge("hub.best_outer")
+        self._g_inner = self.telemetry.gauge("hub.best_inner")
 
     def _mark_spoke_failed(self, i, exc):
         """Prune spoke i out of every wiring set (hub thread only)."""
@@ -111,7 +115,9 @@ class Hub(SPCommunicator):
             if getattr(sp, "_failed", False):
                 continue
             try:
-                sp.step()
+                # in-process Spokes expose the traced step; multiproc
+                # SpokeHandles only a bare no-op step()
+                getattr(sp, "timed_step", sp.step)()
             except Exception as e:
                 self._mark_spoke_failed(i, e)
 
@@ -225,6 +231,9 @@ class Hub(SPCommunicator):
         if ok:
             return True
         self.bound_rejects[i] += 1
+        self._c_rejects.inc()
+        self.telemetry.event("hub.bound_reject", spoke=i, kind=kind,
+                             reason=str(reason))
         n = int(self.bound_rejects[i])
         if n == 1 or n % 10 == 0:       # don't spam a steady NaN stream
             name = getattr(self.spokes[i], "spoke_name",
@@ -241,14 +250,18 @@ class Hub(SPCommunicator):
     def receive_outerbounds(self):
         for i in list(self.outerbound_idx):
             data, wid = self.pairs[i].to_hub.read()
+            self._c_reads.inc()
             if wid > self._spoke_read_ids[i]:
                 self._spoke_read_ids[i] = wid
                 if self._accept_bound("outer", float(data[0]), i):
                     self.OuterBoundUpdate(float(data[0]), i)
+            else:
+                self._c_stale.inc()
 
     def receive_innerbounds(self):
         for i in list(self.innerbound_idx):
             data, wid = self.pairs[i].to_hub.read()
+            self._c_reads.inc()
             if wid > self._spoke_read_ids[i]:
                 self._spoke_read_ids[i] = wid
                 if not self._accept_bound("inner", float(data[0]), i):
@@ -257,12 +270,23 @@ class Hub(SPCommunicator):
                 sol = getattr(self.spokes[i], "best_solution", None)
                 if sol is not None and self.BestInnerBound == float(data[0]):
                     self.best_nonant_solution = sol
+            else:
+                self._c_stale.inc()
+
+    def _record_bound(self, kind, value, gauge):
+        """Bound-progression telemetry: a gauge for snapshots plus a
+        Chrome counter sample so Perfetto graphs hub.bounds over the
+        run (finite values only — Chrome counters are numeric JSON)."""
+        if self.telemetry.enabled and np.isfinite(value):
+            gauge.set(value)
+            self.telemetry.tracer.counter("hub.bounds", {kind: value})
 
     def OuterBoundUpdate(self, new_bound, idx=None, char="*"):
         if self._ob_better(new_bound, self.BestOuterBound):
             self.latest_ob_char = (self.spoke_chars.get(idx, char)
                                    if idx is not None else char)
             self.BestOuterBound = new_bound
+            self._record_bound("outer", new_bound, self._g_outer)
         return self.BestOuterBound
 
     def InnerBoundUpdate(self, new_bound, idx=None, char="*"):
@@ -270,12 +294,14 @@ class Hub(SPCommunicator):
             self.latest_ib_char = (self.spoke_chars.get(idx, char)
                                    if idx is not None else char)
             self.BestInnerBound = new_bound
+            self._record_bound("inner", new_bound, self._g_inner)
         return self.BestInnerBound
 
     # -- outbound (reference hub.py:370-436) ------------------------------
     def send_terminate(self):
         for pair in self.pairs:
             pair.to_spoke.send_kill()
+            self._c_kills.inc()
 
     def hub_finalize(self):
         self._drain_failures()
@@ -311,15 +337,16 @@ class PHHub(Hub):
         self._iter_for_trace = 0
 
     def sync(self):
-        self._drain_failures()
-        if self.supervisor is not None:
-            self.supervisor.poll()
-        self.send_ws()
-        self.send_nonants()
-        if self.drive_spokes_inline:
-            self._step_spokes()
-        self.receive_outerbounds()
-        self.receive_innerbounds()
+        with self.telemetry.span("hub.sync"):
+            self._drain_failures()
+            if self.supervisor is not None:
+                self.supervisor.poll()
+            self.send_ws()
+            self.send_nonants()
+            if self.drive_spokes_inline:
+                self._step_spokes()
+            self.receive_outerbounds()
+            self.receive_innerbounds()
 
     def is_converged(self):
         # seed outer bound with the trivial bound once (reference
@@ -351,6 +378,7 @@ class PHHub(Hub):
         x_na = np.asarray(self.opt.batch.nonants(st.x)).reshape(-1)
         for i in self.nonant_idx_set:
             self.pairs[i].to_spoke.write(x_na)
+            self._c_writes.inc()
 
     def send_ws(self):
         """Push current W (reference hub.py:590)."""
@@ -360,6 +388,7 @@ class PHHub(Hub):
         W = np.asarray(st.W).reshape(-1)
         for i in self.w_idx:
             self.pairs[i].to_spoke.write(W)
+            self._c_writes.inc()
 
 
 class APHHub(PHHub):
@@ -382,15 +411,16 @@ class LShapedHub(Hub):
                 "LShapedHub cannot feed W spokes (reference hub.py:628)")
 
     def sync(self, send_nonants=True):
-        self._drain_failures()
-        if self.supervisor is not None:
-            self.supervisor.poll()
-        if send_nonants:
-            self.send_nonants()
-        if self.drive_spokes_inline:
-            self._step_spokes()
-        self.receive_outerbounds()
-        self.receive_innerbounds()
+        with self.telemetry.span("hub.sync"):
+            self._drain_failures()
+            if self.supervisor is not None:
+                self.supervisor.poll()
+            if send_nonants:
+                self.send_nonants()
+            if self.drive_spokes_inline:
+                self._step_spokes()
+            self.receive_outerbounds()
+            self.receive_innerbounds()
 
     def is_converged(self):
         # the hub's own loop provides both bounds; spokes may improve
@@ -420,3 +450,4 @@ class LShapedHub(Hub):
         flat = np.tile(np.asarray(xhat), (b.num_scens, 1)).reshape(-1)
         for i in self.nonant_idx_set:
             self.pairs[i].to_spoke.write(flat)
+            self._c_writes.inc()

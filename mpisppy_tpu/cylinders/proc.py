@@ -138,6 +138,14 @@ def run_spoke_from_spec(specfile: str) -> int:
 
     from .spcommunicator import WindowPair
 
+    # activate this child's telemetry BEFORE building the optimizer so
+    # every configure_from_options(None) call below picks it up; the
+    # spoke's spans/metrics land in its own files (real pid = own trace
+    # row) which the hub merges after shutdown (spin_the_wheel.py)
+    from .. import telemetry as _telemetry
+    tel_cfg = spec.get("telemetry")
+    tel = _telemetry.configure(tel_cfg) if tel_cfg else _telemetry.get()
+
     bs = spec["batch"]
     builder = getattr(importlib.import_module(bs["module"]), bs["builder"])
     batch = builder(**bs.get("kwargs", {}))
@@ -169,6 +177,13 @@ def run_spoke_from_spec(specfile: str) -> int:
             np.save(f, np.asarray(sol))
         os.replace(tmp, final)
     spoke.finalize()
+    if tel.enabled:
+        tp = tel.config.get("trace_path")
+        if tp:
+            tel.write_trace(tp)
+        mp = tel.config.get("metrics_path")
+        if mp:
+            tel.write_metrics(mp)
     return 0
 
 

@@ -23,6 +23,8 @@ import threading
 
 import numpy as np
 
+from .. import telemetry as _telemetry
+
 
 class Window:
     """One direction of a hub<->spoke pair: a (length+1,) float64
@@ -114,6 +116,19 @@ class SPCommunicator:
         self.opt = spbase_object
         self.options = dict(options or {})
         self.opt.spcomm = self
+        # window-traffic telemetry: handles are bound once here and
+        # shared by every hub/spoke subclass; all of them are null
+        # no-ops when telemetry is off (telemetry/metrics.py)
+        self.telemetry = _telemetry.configure_from_options(
+            self.options.get("telemetry"))
+        # the spans/rows of this cylinder land on this trace track
+        # (None = the hub/main row; WheelSpinner names spoke tracks)
+        self.telemetry_track = None
+        tel = self.telemetry
+        self._c_writes = tel.counter("window.writes")
+        self._c_reads = tel.counter("window.reads")
+        self._c_stale = tel.counter("window.stale_reads")
+        self._c_kills = tel.counter("window.kill_signals")
 
     # lengths of the vectors this cylinder sends/receives; subclasses
     # override (reference: Spoke.make_windows sends its 2 lengths)

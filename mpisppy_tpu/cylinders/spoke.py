@@ -57,16 +57,20 @@ class Spoke(SPCommunicator):
         values = self.chaos.poison(values)
         self.chaos.pre_write()
         self.pair.to_hub.write(values)
+        self._c_writes.inc()
 
     def spoke_from_hub(self):
         """(data, is_new): latest hub vector; is_new iff the write_id
         advanced since our last read (reference spoke.py:93-118)."""
         self.chaos.step_tick()
         data, wid = self.pair.to_spoke.read()
+        self._c_reads.inc()
         if wid == Window.KILL:
             self._killed = True
             return data, False
         is_new = wid > self.last_hub_id
+        if not is_new:
+            self._c_stale.inc()
         self.last_hub_id = max(self.last_hub_id, wid)
         return data, is_new
 
@@ -86,6 +90,19 @@ class Spoke(SPCommunicator):
         threaded loop backs off when a step was a no-op."""
         raise NotImplementedError
 
+    def timed_step(self):
+        """step() under a tracer span on this spoke's own trace track,
+        so each spoke renders as its own row in the merged timeline
+        (telemetry/export.py).  Identical to step() when telemetry is
+        off."""
+        tel = self.telemetry
+        if not tel.enabled:
+            return self.step()
+        tr = tel.tracer
+        with tr.track(self.telemetry_track):
+            with tr.span(f"{type(self).__name__}.step"):
+                return self.step()
+
     def _heartbeat(self):
         """Keep the to_hub write_id advancing so the supervisor can
         tell a slow spoke from a hung one; bound spokes override with
@@ -96,7 +113,7 @@ class Spoke(SPCommunicator):
         while not self.got_kill_signal():
             did = False
             if self.get_serial_number() != 0:
-                did = self.step()
+                did = self.timed_step()
             now = time.time()
             if now - self._last_heartbeat >= self.heartbeat_interval:
                 self._last_heartbeat = now

@@ -178,6 +178,10 @@ class PHBase(SPOpt):
         self.trivial_bound = None
         self.best_bound = None
         self._superstep = jax.jit(self._superstep_impl)
+        # per-phase jitted pieces of the superstep, built lazily the
+        # first time telemetry phase timing runs (telemetry/; the fused
+        # _superstep above stays the only path when telemetry is off)
+        self._phase_jits = None
         self.conv = None
 
         # effective bounds: extensions (Fixer, slamming) pin nonants by
@@ -298,6 +302,11 @@ class PHBase(SPOpt):
         self.conv = float(conv)
         global_toc(f"Iter0 trivial bound = {self.trivial_bound:.6g}, "
                    f"conv = {float(conv):.6g}")
+        if self._tel.enabled:
+            self._tel.event("ph.iter0",
+                            trivial_bound=self.trivial_bound,
+                            feas_mass=self.iter0_feas_mass,
+                            conv=self.conv)
         self._ext("post_iter0")
         return self.trivial_bound
 
@@ -341,24 +350,118 @@ class PHBase(SPOpt):
             return self.solver_eps
         return jnp.asarray(self._superstep_eps_opt, self.batch.c.dtype)
 
+    def _run_superstep(self):
+        """Advance self.state by one superstep and sync.  Telemetry
+        phase timing (when ON) routes through the unfused per-phase
+        path; otherwise this is byte-for-byte the pre-telemetry fused
+        call — the zero-cost-when-off contract of telemetry/."""
+        if self._tel.phase_timing:
+            self._superstep_phased()
+        else:
+            self.state = self._superstep(
+                self.state, self.rho, self.W_on, self.prox_on,
+                self.lb_eff, self.ub_eff, self.superstep_eps, self.prep,
+                self.batch)
+            jax.block_until_ready(self.state.x)
+
+    def _phase_impls(self):
+        """Jitted per-phase cuts of _superstep_impl (solve / xbar-psum
+        / W-update / conv), functionally identical to the fused body —
+        only the phase boundaries differ, so the phase-timed iteration
+        produces the same PHState."""
+        fns = self._phase_jits
+        if fns is not None:
+            return fns
+
+        def solve(state, rho, W_on, prox_on, lb, ub, eps, prep, batch):
+            c_eff, q_eff = ph_objective_arrays(
+                batch, state.W, rho, state.xbar,
+                W_on=W_on, prox_on=prox_on)
+            return self.solver._solve_jit(
+                prep, c_eff, q_eff, lb, ub, batch.obj_const,
+                state.x, state.y, None, eps)
+
+        def xbar(batch, x):
+            x_na = batch.nonants(x)
+            return (x_na,) + compute_xbar(batch, x_na)
+
+        def w_up(W, rho, x_na, xbar_):
+            return update_W(W, rho, x_na, xbar_)
+
+        def conv(batch, x_na, xbar_, x):
+            return convergence_metric(batch, x_na, xbar_), \
+                batch.objective(x)
+
+        fns = {"solve": jax.jit(solve), "xbar": jax.jit(xbar),
+               "w_update": jax.jit(w_up), "conv": jax.jit(conv)}
+        self._phase_jits = fns
+        return fns
+
+    def _superstep_phased(self):
+        """One PH iteration with per-phase spans + timing histograms
+        (ph.phase.{solve,psum,w_update,conv}_seconds).  Each phase runs
+        as its own jitted call with a device sync between phases — the
+        observability/fusion trade the telemetry docs call out, which
+        is why this path exists ONLY behind tel.phase_timing."""
+        tel = self._tel
+        st, b = self.state, self.batch
+        fns = self._phase_impls()
+        t0 = time.monotonic()
+        with tel.span("ph.phase.solve"):
+            res = fns["solve"](st, self.rho, self.W_on, self.prox_on,
+                               self.lb_eff, self.ub_eff,
+                               self.superstep_eps, self.prep, b)
+            jax.block_until_ready(res.x)
+        t1 = time.monotonic()
+        with tel.span("ph.phase.psum"):
+            x_na, xbar, xsqbar = fns["xbar"](b, res.x)
+            jax.block_until_ready(xbar)
+        t2 = time.monotonic()
+        with tel.span("ph.phase.w_update"):
+            W = fns["w_update"](st.W, self.rho, x_na, xbar)
+            jax.block_until_ready(W)
+        t3 = time.monotonic()
+        with tel.span("ph.phase.conv"):
+            conv, obj = fns["conv"](b, x_na, xbar, res.x)
+            jax.block_until_ready(conv)
+        t4 = time.monotonic()
+        hist = tel.registry.histogram
+        hist("ph.phase.solve_seconds").observe(t1 - t0)
+        hist("ph.phase.psum_seconds").observe(t2 - t1)
+        hist("ph.phase.w_update_seconds").observe(t3 - t2)
+        hist("ph.phase.conv_seconds").observe(t4 - t3)
+        self.state = PHState(
+            x=res.x, y=res.y, W=W, xbar=xbar, xsqbar=xsqbar,
+            obj=obj, dual_obj=res.dual_obj, conv=conv, it=st.it + 1,
+            solve_iters=res.iters)
+
     def ph_iteration(self):
         self._ext("pre_solve_loop")
         t0 = time.time()
-        self.state = self._superstep(
-            self.state, self.rho, self.W_on, self.prox_on,
-            self.lb_eff, self.ub_eff, self.superstep_eps, self.prep,
-            self.batch)
+        tel = self._tel
+        if tel.enabled:
+            with tel.span("ph.iteration"):
+                self._run_superstep()
+        else:
+            self._run_superstep()
         # account the superstep's kernel work (utils/mfu): iters ride
         # along in the state so no extra device sync is needed beyond
         # the conv readback below
-        jax.block_until_ready(self.state.x)
         b = self.batch
+        it_n = int(self.state.solve_iters)
         self._flops += _mfu.pdhg_flops(
-            int(self.state.solve_iters), b.num_scens, b.num_rows,
+            it_n, b.num_scens, b.num_rows,
             b.num_vars, self.solver.check_every)
-        self._solve_wall += time.time() - t0
+        self._kernel_iters += it_n
+        wall = time.time() - t0
+        self._solve_wall += wall
         self._ext("post_solve_loop")
         self.conv = float(self.state.conv)
+        if tel.enabled:
+            r = tel.registry
+            r.counter("ph.iterations").inc()
+            r.histogram("ph.iteration_seconds").observe(wall)
+            r.gauge("ph.conv").set(self.conv)
         return self.conv
 
     # -- crash-resume (resilience/checkpoint.py) --------------------------
@@ -484,6 +587,7 @@ class PHBase(SPOpt):
         (options key "lagrangian_eps") — a looser y costs bound
         tightness, never validity (in the auto/LP case)."""
         self.check_W_bound_supported()
+        self._tel.counter("ph.lagrangian_bound_calls").inc()
         b = self.batch
         W = self.state.W if W is None else W
         c_eff = b.c.at[:, b.nonant_idx].add(W)

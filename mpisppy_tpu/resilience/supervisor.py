@@ -43,6 +43,7 @@ import signal
 import time
 
 from .. import global_toc
+from .. import telemetry as _telemetry
 
 LIVE, WAITING, STOPPED, FAILED = "live", "waiting", "stopped", "failed"
 
@@ -87,6 +88,10 @@ class SpokeSupervisor:
         self.spoke_restarts = 0
         self.spokes_failed = 0
         self.exit_reports = []             # dicts: spoke/rc/log_tail/...
+        # lifecycle events land in the shared telemetry event log /
+        # metrics (no-ops when telemetry is off); tolerate bare fake
+        # hubs in tests that lack a .telemetry attribute
+        self._tel = getattr(hub, "telemetry", None) or _telemetry.get()
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
@@ -101,6 +106,9 @@ class SpokeSupervisor:
         self.state[i] = LIVE
         self._last_wid[i] = None
         self._last_progress[i] = time.monotonic()
+        self._tel.event("supervisor.spawn", spoke=i,
+                        incarnation=self.restarts[i],
+                        pid=getattr(p, "pid", None))
 
     # -- supervision (hub thread, called from Hub.sync) -------------------
     def poll(self, force=False):
@@ -132,7 +140,10 @@ class SpokeSupervisor:
             if wid != self._last_wid[i]:
                 self._last_wid[i] = wid
                 self._last_progress[i] = now
-            elif now - self._last_progress[i] > self.hang_timeout:
+            self._tel.gauge(f"supervisor.heartbeat_age.spoke{i}").set(
+                now - self._last_progress[i])
+            if wid == self._last_wid[i] \
+                    and now - self._last_progress[i] > self.hang_timeout:
                 self._kill_escalating(i)
                 rc = h.proc.poll()
                 self._record_exit(i, rc, hung=True)
@@ -145,10 +156,12 @@ class SpokeSupervisor:
         p = self.handles[i].proc
         self.killed_by_us.add(p.pid)
         try:
+            self._tel.event("supervisor.sigterm", spoke=i, pid=p.pid)
             p.send_signal(signal.SIGTERM)
             p.wait(timeout=self.term_deadline)
         except Exception:
             try:
+                self._tel.event("supervisor.sigkill", spoke=i, pid=p.pid)
                 p.kill()
                 p.wait(timeout=self.term_deadline)
             except Exception:      # pragma: no cover - unkillable child
@@ -173,12 +186,18 @@ class SpokeSupervisor:
                         self.backoff_cap)
             self._next_restart[i] = time.monotonic() + delay
             self.state[i] = WAITING
+            self._tel.event("supervisor.restart", spoke=i, reason=reason,
+                            incarnation=self.restarts[i], delay=delay)
+            self._tel.counter("supervisor.restarts").inc()
             global_toc(f"WARNING: spoke {i} ({h.spoke_name}) {reason}; "
                        f"restart {self.restarts[i]}/{self.max_restarts} "
                        f"in {delay:.2f}s")
         else:
             self.state[i] = FAILED
             self.spokes_failed += 1
+            self._tel.event("supervisor.prune", spoke=i, reason=reason,
+                            restarts=self.restarts[i])
+            self._tel.counter("supervisor.spokes_failed").inc()
             tail = self.exit_reports[-1]["log_tail"] if self.exit_reports \
                 else ""
             self.hub._mark_spoke_failed(i, RuntimeError(

@@ -20,6 +20,7 @@ Dict schema mirrors the reference / vanilla factories:
 
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
@@ -100,6 +101,10 @@ class WheelSpinner:
             sp_opt = sd["opt_class"](**kw)
             spoke = sd["spoke_class"](
                 sp_opt, options=sd.get("spoke_kwargs", {}).get("options"))
+            # each in-process spoke gets its own row in the merged
+            # trace timeline (telemetry/tracer.py track pids)
+            spoke.telemetry_track = (
+                f"spoke{len(spokes)}:{type(spoke).__name__}")
             spokes.append(spoke)
 
         hub = hd["hub_class"](
@@ -166,8 +171,21 @@ class WheelSpinner:
             except Exception as e:  # a failing final pass must not eat
                 global_toc(f"spoke finalize failed: {e}")  # the results
         hub.hub_finalize()
+        self._flush_telemetry()
         self._ran = True
         return self
+
+    def _flush_telemetry(self, extra_trace_files=()):
+        """Write trace.json (hub + every spoke row merged onto one
+        timeline) + metrics.jsonl into the configured telemetry dir.
+        No-op when telemetry is off or has no output dir."""
+        from . import telemetry as _telemetry
+        tel = (getattr(self.spcomm, "telemetry", None)
+               or _telemetry.get())
+        path = tel.flush(extra_trace_files=extra_trace_files)
+        if path is not None:
+            global_toc(f"WheelSpinner: telemetry written to "
+                       f"{os.path.dirname(path)}")
 
     def _spin_multiproc(self):
         """Hub + spokes as SEPARATE OS processes over the native mmap
@@ -212,7 +230,7 @@ class WheelSpinner:
             # the child must pad to the hub's (possibly device-padded)
             # scenario count or the W/nonant window reshape disagrees
             bspec = dict(sd["proc"]["batch"], pad_to=b.num_scens)
-            specs.append({
+            spec = {
                 "batch": bspec,
                 "opt_class": f"{ocls.__module__}:{ocls.__name__}",
                 "spoke_class": f"{scls.__module__}:{scls.__name__}",
@@ -221,7 +239,23 @@ class WheelSpinner:
                 "scenario_names": list(okw["all_scenario_names"]),
                 "windows": {"prefix": prefix,
                             "hub_length": recv, "spoke_length": send},
-            })
+            }
+            # child-process telemetry: each spoke records into its own
+            # trace file (real pid = own timeline row); the hub merges
+            # them into the single trace.json after shutdown
+            from . import telemetry as _telemetry
+            tel = _telemetry.get()
+            if tel.enabled and tel.out_dir:
+                spec["telemetry"] = {
+                    "enabled": True,
+                    "phase_timing": tel.phase_timing,
+                    "main_label": f"spoke{i}:{scls.__name__}",
+                    "trace_path": os.path.join(
+                        tel.out_dir, f"trace_spoke{i}.json"),
+                    "metrics_path": os.path.join(
+                        tel.out_dir, f"metrics_spoke{i}.jsonl"),
+                }
+            specs.append(spec)
 
         hub = hd["hub_class"](
             hub_opt, handles,
@@ -281,6 +315,12 @@ class WheelSpinner:
         elif ok and sup.exit_reports:
             global_toc(f"WheelSpinner[multiproc]: spoke failure logs "
                        f"kept in {workdir}")
+        # merge every child's trace file (written by run_spoke_from_spec
+        # after its kill signal) into the hub's single timeline
+        child_traces = [s["telemetry"]["trace_path"] for s in specs
+                        if "telemetry" in s
+                        and os.path.exists(s["telemetry"]["trace_path"])]
+        self._flush_telemetry(extra_trace_files=child_traces)
         self._ran = True
         return self
 

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import global_toc
+from . import telemetry as _telemetry
 from .ir import SplitA, bmatvec, delta_idx
 from .ops.pdhg import (PDHGSolver, PreparedBatch, prepare_batch,
                        prepare_batch_split, prepare_split_native)
@@ -86,6 +87,11 @@ class SPOpt(SPBase):
         self._flops = 0.0          # accumulated kernel FLOPs (utils/mfu)
         self._solve_wall = 0.0     # accumulated timed solve seconds
         self._certify_wall = 0.0   # seconds inside f64 certified re-solves
+        self._kernel_iters = 0     # accumulated PDHG kernel iterations
+        # telemetry (telemetry/): the options value configures the
+        # process-global handle; every instrument lookup below is a
+        # null no-op when disabled (zero-cost-when-off contract)
+        self._tel = _telemetry.configure_from_options(o.get("telemetry"))
         # dynamic solver tolerance (Gapper schedules it) as a jnp
         # scalar — traced, so schedule changes never recompile
         self.solver_eps = jnp.asarray(self.solver.eps, self.batch.c.dtype)
@@ -128,6 +134,8 @@ class SPOpt(SPBase):
         """
         b = self.batch
         t0 = time.time()
+        tel = self._tel
+        tn0 = time.monotonic_ns() if tel.enabled else 0
         if isinstance(warm, str):
             cache = self._named_warm.get(warm, (None, None))
         else:
@@ -144,9 +152,11 @@ class SPOpt(SPBase):
             eps=self.solver_eps if eps is None else eps,
             iters_cap=iters_cap,
         )
+        it_n = int(res.iters)
         self._flops += _mfu.pdhg_flops(
-            int(res.iters), b.num_scens, b.num_rows, b.num_vars,
+            it_n, b.num_scens, b.num_rows, b.num_vars,
             self.solver.check_every)
+        self._kernel_iters += it_n
         if certify:
             select = None
             if certify == "feas":
@@ -162,6 +172,15 @@ class SPOpt(SPBase):
         jax.block_until_ready(res.x)
         dt = time.time() - t0
         self._solve_wall += dt
+        if tel.enabled:
+            tel.tracer.record_span("solve.loop", tn0,
+                                   time.monotonic_ns())
+            r = tel.registry
+            r.counter("solve.calls").inc()
+            r.counter("solve.kernel_iters").inc(it_n)
+            r.histogram("solve.seconds").observe(dt)
+            _mfu.record_to_registry(r, self._flops, self._solve_wall,
+                                    kernel_iters=self._kernel_iters)
         if dtiming or self.options.get("display_timing"):
             self._solve_times.append(dt)
             global_toc(f"solve_loop: {dt*1e3:8.1f} ms, "
@@ -316,7 +335,13 @@ class SPOpt(SPBase):
         self._flops += _mfu.pdhg_flops(
             int(r64.iters), idx.size, b.num_rows, b.num_vars,
             self.solver.check_every)
-        self._certify_wall += time.time() - t_cert
+        dt_cert = time.time() - t_cert
+        self._certify_wall += dt_cert
+        if self._tel.enabled:
+            r = self._tel.registry
+            r.counter("solve.certify_calls").inc()
+            r.counter("solve.certify_scenarios").inc(int(idx.size))
+            r.histogram("solve.certify_seconds").observe(dt_cert)
         n_ok = int(np.sum(np.asarray(r64.converged)))
         if n_ok < idx.size:
             global_toc(f"WARNING: f64 fallback left {idx.size - n_ok} "
@@ -370,6 +395,7 @@ class SPOpt(SPBase):
         self._flops = 0.0
         self._solve_wall = 0.0
         self._certify_wall = 0.0
+        self._kernel_iters = 0
         self._solve_times = []
 
     def solve_stats(self):
@@ -378,6 +404,10 @@ class SPOpt(SPBase):
         utilization — see utils/mfu.py)."""
         dev = jax.devices()[0]
         u = _mfu.mfu(self._flops, self._solve_wall, dev)
+        _mfu.record_to_registry(self._tel.registry, self._flops,
+                                self._solve_wall,
+                                kernel_iters=self._kernel_iters,
+                                device=dev)
         return {
             "flops": self._flops,
             "solve_wall_s": self._solve_wall,

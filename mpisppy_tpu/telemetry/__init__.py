@@ -1,0 +1,205 @@
+"""Telemetry subsystem: structured tracing, metrics, trace export.
+
+Three layers (doc/src/telemetry.md):
+
+  * `tracer` — span() tracing over CLOCK_MONOTONIC into a lock-free
+    ring buffer, exported as Chrome/Perfetto trace-event JSON with one
+    process row per hub/spoke (export.chrome_events / merge_traces);
+  * `metrics` — counters / gauges / time-value histograms + a bounded
+    event log, snapshotted to JSONL and optionally Prometheus text;
+  * this facade — ONE process-global `Telemetry` handle configured
+    from `options["telemetry"]` or the MPISPPY_TPU_TELEMETRY env var
+    (env wins, same layering as resilience.chaos), held by every
+    instrumented object (`SPOpt._tel`, `SPCommunicator.telemetry`).
+
+Zero-cost-when-off: a disabled handle exposes the shared NullTracer /
+null-instrument registry, so hot paths hold real references and the
+off-path cost is an attribute read and a false branch — no allocation,
+no host sync, and (structurally: this package never imports jax) no
+`block_until_ready` anywhere in the telemetry layer.
+
+Config forms accepted (options value or env var):
+    None / False / "0"|"off"|"false"      disabled (default)
+    True / "1"|"on"|"true"                enabled, no files written
+    "<dir>"                               enabled, artifacts under dir
+    {"enabled": ..., "dir": ..., "phase_timing": ...,
+     "capacity": ..., "prometheus": ..., "main_label": ...}   full form
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from . import export
+from .metrics import MetricsRegistry
+from .tracer import NULL_SPAN, NULL_TRACER, Tracer  # noqa: F401
+
+ENV_VAR = "MPISPPY_TPU_TELEMETRY"
+
+_DEFAULTS = {
+    "enabled": False,
+    "dir": None,
+    # phase_timing: time the superstep's four phases individually (the
+    # superstep runs UNFUSED when on — see phbase._superstep_phased)
+    "phase_timing": True,
+    "capacity": 65536,
+    "prometheus": False,
+    "main_label": "hub",
+}
+
+_FALSY = ("", "0", "off", "false", "no")
+_TRUTHY = ("1", "on", "true", "yes")
+
+
+def _norm(config):
+    """Any accepted config form -> partial dict (or None for 'unset')."""
+    if config is None:
+        return None
+    if isinstance(config, bool):
+        return {"enabled": config}
+    if isinstance(config, str):
+        s = config.strip()
+        if s.lower() in _FALSY:
+            return {"enabled": False}
+        if s.startswith("{"):
+            try:
+                d = json.loads(s)
+            except ValueError:
+                return {"enabled": True}
+            return dict(d, enabled=d.get("enabled", True))
+        if s.lower() in _TRUTHY:
+            return {"enabled": True}
+        return {"enabled": True, "dir": s}
+    d = dict(config)
+    d.setdefault("enabled", True)
+    return d
+
+
+def _effective(config):
+    """defaults <- caller config <- env var (env wins — the same
+    override layering as resilience.chaos.ChaosInjector)."""
+    cfg = dict(_DEFAULTS)
+    c = _norm(config)
+    if c:
+        cfg.update(c)
+    env = _norm(os.environ.get(ENV_VAR))
+    if env:
+        cfg.update(env)
+    return cfg
+
+
+class Telemetry:
+    """One configured telemetry instance: a tracer + a registry."""
+
+    def __init__(self, config=None):
+        self.config = _effective(config)
+        self.enabled = bool(self.config["enabled"])
+        self.phase_timing = self.enabled and bool(
+            self.config["phase_timing"])
+        self.out_dir = self.config.get("dir")
+        if self.enabled:
+            self.tracer = Tracer(
+                capacity=self.config["capacity"],
+                main_label=self.config.get("main_label", "hub"))
+            self.registry = MetricsRegistry(enabled=True)
+        else:
+            self.tracer = NULL_TRACER
+            self.registry = MetricsRegistry(enabled=False)
+
+    # -- hot-path API -----------------------------------------------------
+    def span(self, name, track=None, **args):
+        if not self.enabled:
+            return NULL_SPAN
+        return self.tracer.span(name, track=track, args=args or None)
+
+    def event(self, name, track=None, **args):
+        """Instant trace event + entry in the registry event log."""
+        if self.enabled:
+            self.tracer.instant(name, track=track, args=args or None)
+            self.registry.event(name, **args)
+
+    def counter(self, name):
+        return self.registry.counter(name)
+
+    def gauge(self, name):
+        return self.registry.gauge(name)
+
+    def histogram(self, name):
+        return self.registry.histogram(name)
+
+    # -- export -----------------------------------------------------------
+    def write_trace(self, path):
+        return export.write_trace(path, export.chrome_events(self.tracer))
+
+    def write_metrics(self, path):
+        return self.registry.write_jsonl(path)
+
+    def flush(self, out_dir=None, extra_trace_files=()):
+        """Write trace.json (merged with any per-spoke-process files),
+        metrics.jsonl, and (if configured) prometheus.txt under
+        out_dir (default: the configured dir).  Returns the trace path
+        or None when disabled / no dir."""
+        d = out_dir or self.out_dir
+        if not (self.enabled and d):
+            return None
+        os.makedirs(d, exist_ok=True)
+        trace = export.merge_traces(
+            os.path.join(d, "trace.json"),
+            event_lists=[export.chrome_events(self.tracer)],
+            trace_files=extra_trace_files)
+        self.registry.write_jsonl(os.path.join(d, "metrics.jsonl"))
+        if self.config.get("prometheus"):
+            self.registry.write_prometheus(
+                os.path.join(d, "prometheus.txt"))
+        return trace
+
+
+_active: Telemetry | None = None
+
+
+def get() -> Telemetry:
+    """The process-global handle (lazily built from the env var alone
+    the first time; disabled unless MPISPPY_TPU_TELEMETRY enables it)."""
+    global _active
+    if _active is None:
+        _active = Telemetry(None)
+    return _active
+
+
+def configure(config=None) -> Telemetry:
+    """Install a fresh global Telemetry from `config` (+env overlay)."""
+    global _active
+    _active = Telemetry(config)
+    return _active
+
+
+def configure_from_options(config) -> Telemetry:
+    """Install telemetry from an options-dict value.  None leaves the
+    active instance untouched (the env var may still have enabled it);
+    an IDENTICAL effective config is idempotent — the wheel builds
+    several optimizers from copies of one options dict and they must
+    share one registry/tracer, not reset each other."""
+    if config is None:
+        return get()
+    cand = _effective(config)
+    if _active is not None and _active.config == cand:
+        return _active
+    return configure(config)
+
+
+def reset():
+    """Drop the global instance (tests)."""
+    global _active
+    _active = None
+
+
+def traffic_counters(registry=None):
+    """Window-traffic counter dict for bench JSON (zeros when the run
+    had telemetry off — keys are stable either way)."""
+    reg = registry if registry is not None else get().registry
+    names = ("window.writes", "window.reads", "window.stale_reads",
+             "window.kill_signals", "window.bound_rejects")
+    vals = ({k: c.value for k, c in reg._counters.items()}
+            if reg.enabled else {})
+    return {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}

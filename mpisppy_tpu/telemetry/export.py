@@ -1,0 +1,84 @@
+"""Chrome/Perfetto trace-event serialization and multi-process merge.
+
+Output is the Chrome trace-event JSON object format
+({"traceEvents": [...]}) — open in https://ui.perfetto.dev or
+chrome://tracing.  Each track (hub, every spoke) renders as its own
+process row via "M" process_name metadata; cross-process merging works
+because every recorder stamps CLOCK_MONOTONIC (system-wide on Linux),
+so hub and spoke-process events share one time base.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_CAT = "mpisppy_tpu"
+
+
+def chrome_events(tracer):
+    """Convert a Tracer's retained records to Chrome trace events,
+    prefixed with per-row process_name metadata."""
+    events = [{"ph": "M", "name": "process_name", "pid": tracer._pid,
+               "tid": 0, "args": {"name": tracer.main_label}}]
+    for label, pid in tracer._tracks.items():
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+    for rec in tracer.records():
+        kind = rec[0]
+        if kind == "X":
+            _, name, pid, tid, ts, dur, args = rec
+            e = {"ph": "X", "cat": _CAT, "name": name, "pid": pid,
+                 "tid": tid, "ts": ts, "dur": dur}
+        elif kind == "i":
+            _, name, pid, tid, ts, args = rec
+            e = {"ph": "i", "s": "p", "cat": _CAT, "name": name,
+                 "pid": pid, "tid": tid, "ts": ts}
+        else:  # "C"
+            _, name, pid, ts, values = rec
+            e = {"ph": "C", "cat": _CAT, "name": name, "pid": pid,
+                 "tid": 0, "ts": ts, "args": values}
+            events.append(e)
+            continue
+        if args:
+            e["args"] = args
+        events.append(e)
+    return events
+
+
+def write_trace(path, events):
+    """Atomic write of one trace file."""
+    payload = {"traceEvents": list(events), "displayTimeUnit": "ms"}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+def load_trace_events(path):
+    """Events from a trace file; [] for missing/corrupt files (a spoke
+    SIGKILLed mid-write must not take down the hub's merge)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if isinstance(data, dict):
+        return data.get("traceEvents", [])
+    return data if isinstance(data, list) else []
+
+
+def merge_traces(out_path, event_lists=(), trace_files=()):
+    """Merge in-memory event lists + per-spoke trace FILES into one
+    timeline file.  Metadata events sort first so every row is named
+    before its first real event; the rest sort by timestamp."""
+    merged = []
+    for evs in event_lists:
+        merged.extend(evs)
+    for p in trace_files:
+        merged.extend(load_trace_events(p))
+    meta = [e for e in merged if e.get("ph") == "M"]
+    rest = sorted((e for e in merged if e.get("ph") != "M"),
+                  key=lambda e: e.get("ts", 0))
+    return write_trace(out_path, meta + rest)

@@ -9,6 +9,8 @@ report_by_moving_stats).  Vectorized over the whole (S, K) W tensor.
 
 from __future__ import annotations
 
+import collections
+
 import numpy as np
 
 
@@ -16,7 +18,9 @@ class WTracker:
     def __init__(self, ph, wlen=10):
         self.opt = ph
         self.wlen = int(wlen)
-        self._hist = []       # list of (iter, (S, K) np array)
+        # (iter, (S, K) np array) entries; deque(maxlen) evicts the
+        # oldest in O(1) — list.pop(0) is O(n) per iteration
+        self._hist = collections.deque(maxlen=self.wlen)
 
     def grab_local_Ws(self):
         """Record this iteration's W (reference wtracker.py:46)."""
@@ -24,8 +28,6 @@ class WTracker:
         if st is None:
             return
         self._hist.append((int(st.it), np.asarray(st.W).copy()))
-        if len(self._hist) > self.wlen:
-            self._hist.pop(0)
 
     def moving_stats(self):
         """(mean, std) arrays (S, K) over the window; None if empty."""

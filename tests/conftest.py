@@ -31,3 +31,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: deterministic fault-injection tests "
         "(resilience layer); these RUN under tier-1's `-m 'not slow'`")
+    config.addinivalue_line(
+        "markers", "telemetry: observability-layer tests (tracing, "
+        "metrics, trace export); these RUN under tier-1's "
+        "`-m 'not slow'`")
