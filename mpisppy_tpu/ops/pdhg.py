@@ -35,6 +35,7 @@ cylinders/lagrangian_bounder.py) for free — see `dual_objective`.
 from __future__ import annotations
 
 import dataclasses
+import threading as _threading
 from functools import partial
 from typing import Any
 
@@ -365,6 +366,40 @@ class _Carry:
 _register(_Carry, tuple(f.name for f in dataclasses.fields(_Carry)))
 
 
+# Per-THREAD solve-jit registry.  Every cylinder of a wheel (hub +
+# spokes) and every serve-layer request builds its own PDHGSolver from
+# the same options; a per-instance `jax.jit(self._solve_impl)` would
+# give each instance a private trace cache and re-compile the identical
+# computation.  `_solve_impl` depends only on the construction-time
+# scalars in `config_key()`, so instances with equal config share ONE
+# wrapper — jit's own cache then buckets on argument shapes/dtypes
+# exactly as before.  The registry is thread-local, NOT process-global,
+# and `PDHGSolver._solve_jit` resolves through it at CALL time (a
+# property), not at construction: threaded cylinder wheels construct
+# every cylinder on the main thread and then dispatch hub and spoke
+# solves concurrently from worker threads, and concurrent calls into
+# one shared jit wrapper deadlock the dispatch path (observed: all
+# threads futex-parked under test_cylinders threaded mode).  Call-time
+# per-thread scoping keeps the dedup win inside each thread while
+# preserving the pre-registry invariant that no two threads ever race
+# one wrapper — whichever thread DRIVES a solver gets (and reuses) its
+# own wrapper, regardless of which thread built the solver.
+_SOLVE_JIT_TLS = _threading.local()
+
+
+def shared_solve_jit(solver):
+    """The thread-shared jitted `_solve_impl` for `solver`'s config."""
+    reg = getattr(_SOLVE_JIT_TLS, "registry", None)
+    if reg is None:
+        reg = _SOLVE_JIT_TLS.registry = {}
+    key = solver.config_key()
+    fn = reg.get(key)
+    if fn is None:
+        fn = jax.jit(solver._solve_impl)
+        reg[key] = fn
+    return fn
+
+
 class PDHGSolver:
     """Restarted PDHG solver over a ScenarioBatch.
 
@@ -400,7 +435,38 @@ class PDHGSolver:
         self.use_pallas = bool(use_pallas)
         self.pallas_tile = int(pallas_tile)
         self.pallas_interpret = bool(pallas_interpret)
-        self._solve_jit = jax.jit(self._solve_impl)
+
+    @property
+    def _solve_jit(self):
+        # resolved per CALLING thread (see _SOLVE_JIT_TLS above): the
+        # thread that runs the solve owns the wrapper, never a thread
+        # that merely constructed the solver
+        return shared_solve_jit(self)
+
+    @classmethod
+    def from_options(cls, options):
+        """Build a solver from an SPBase-style options dict (the pdhg_*
+        keys).  The one place the option names/defaults are mapped —
+        SPOpt and the serve layer's compile cache both route through
+        here so a request's bucket is keyed on the exact solver config
+        the in-process optimizer would use."""
+        o = options or {}
+        return cls(
+            max_iters=int(o.get("pdhg_max_iters", 20000)),
+            eps=float(o.get("pdhg_eps", 1e-6)),
+            check_every=int(o.get("pdhg_check_every", 40)),
+            restart_every=int(o.get("pdhg_restart_every", 16)),
+            use_pallas=o.get("pdhg_use_pallas", "auto"),
+            pallas_tile=int(o.get("pdhg_pallas_tile", 8)),
+            pallas_interpret=bool(o.get("pdhg_pallas_interpret", False)))
+
+    def config_key(self):
+        """Hashable construction-time config.  `_solve_impl` reads ONLY
+        these attributes, so two solvers with equal keys trace to the
+        same computation and may share one jit wrapper."""
+        return (self.max_iters, self.eps, self.check_every,
+                self.restart_every, self.omega0, self.use_pallas,
+                self.pallas_tile, self.pallas_interpret)
 
     # -- public ----------------------------------------------------------
     def solve(self, prep: PreparedBatch, c, qdiag, lb, ub,

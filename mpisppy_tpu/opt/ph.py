@@ -7,6 +7,8 @@ PH_Prep -> Iter0 -> iterk_loop -> post_loops, returning
 
 from __future__ import annotations
 
+import numpy as np
+
 from .. import global_toc
 from ..phbase import PHBase
 
@@ -32,3 +34,18 @@ class PH(PHBase):
                        f"trivial_bound={trivial:.6g}")
             return self.conv, eobj, trivial
         return self.conv, None, trivial
+
+    def solution_dict(self, finalize=True):
+        """The `ph_main` return values as a structured dict — the serve
+        layer's response envelope (serve/service.py, doc/src/serve.md).
+        `conv`/`eobj`/`trivial_bound` carry exactly the floats ph_main
+        would return on this instance's current state."""
+        eobj = self.post_loops() if finalize else None
+        return {
+            "conv": self.conv,
+            "eobj": eobj,
+            "trivial_bound": self.trivial_bound,
+            "xbar": np.asarray(self.root_xbar()),
+            "iterations": int(self.state.it),
+            "solve_iters": int(self.state.solve_iters),
+        }

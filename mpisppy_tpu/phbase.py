@@ -19,6 +19,8 @@ code for 2-stage (1 node) and multistage.
 from __future__ import annotations
 
 import dataclasses
+import functools
+import threading
 import time
 from typing import Any
 
@@ -134,6 +136,66 @@ def convergence_metric(batch: ScenarioBatch, x_na, xbar):
     return jnp.sum(batch.prob * per_scen)
 
 
+def ph_superstep(solver, state: PHState, rho, W_on, prox_on,
+                 lb, ub, eps, prep, batch):
+    """One fused PH iteration as a pure function of its inputs:
+    solve -> xbar consensus -> W update -> convergence metric.
+
+    Everything that varies per run — scenario data, rho, bounds,
+    tolerance, prepared matrices — is a traced ARGUMENT, so one lowered
+    computation serves every PH instance (and every serve-layer
+    request) with the same shapes: the executable is keyed only on the
+    solver config (via `fused_superstep`) plus jit's own shape bucket.
+    This is also what lets the serve layer vmap the whole superstep
+    over a leading request axis."""
+    c_eff, q_eff = ph_objective_arrays(
+        batch, state.W, rho, state.xbar, W_on=W_on, prox_on=prox_on)
+    res = solver._solve_jit(
+        prep, c_eff, q_eff, lb, ub, batch.obj_const,
+        state.x, state.y, None, eps)
+    x_na = batch.nonants(res.x)
+    xbar, xsqbar = compute_xbar(batch, x_na)
+    W = update_W(state.W, rho, x_na, xbar)
+    conv = convergence_metric(batch, x_na, xbar)
+    # report the TRUE objective at x (c, not c_eff)
+    obj = batch.objective(res.x)
+    return PHState(
+        x=res.x, y=res.y, W=W, xbar=xbar, xsqbar=xsqbar,
+        obj=obj, dual_obj=res.dual_obj, conv=conv, it=state.it + 1,
+        solve_iters=res.iters)
+
+
+# Per-THREAD fused-superstep registry, mirroring
+# ops.pdhg._SOLVE_JIT_TLS: `ph_superstep` depends on the solver only
+# through its config, so every PHBase whose solver shares a config_key
+# (within one thread) shares ONE jitted wrapper.  Before this registry
+# each instance jitted a bound method and re-traced/re-compiled the
+# identical superstep.  Thread-local, not process-global, and resolved
+# at CALL time (`PHBase._superstep` is a property), for the same reason
+# as the solve-jit registry: threaded cylinder wheels construct every
+# cylinder on the main thread but dispatch concurrently from worker
+# threads, and concurrent calls into one jit wrapper deadlock —
+# call-time per-thread scoping preserves the invariant that no two
+# threads race one wrapper.  The serve layer's batch=1 path runs this identical
+# lowered computation (same function, same config, same shapes), which
+# is what makes its result bitwise equal to a standalone `PH.ph_main`
+# (asserted in tests/test_serve.py).
+_SUPERSTEP_TLS = threading.local()
+
+
+def fused_superstep(solver):
+    """The thread-shared jitted PH superstep for `solver`'s config."""
+    reg = getattr(_SUPERSTEP_TLS, "registry", None)
+    if reg is None:
+        reg = _SUPERSTEP_TLS.registry = {}
+    key = solver.config_key()
+    fn = reg.get(key)
+    if fn is None:
+        fn = jax.jit(functools.partial(ph_superstep, solver))
+        reg[key] = fn
+    return fn
+
+
 class PHBase(SPOpt):
     """Shared PH machinery; algorithm drivers (opt/ph.py, opt/aph.py)
     subclass this."""
@@ -177,10 +239,9 @@ class PHBase(SPOpt):
         self.state: PHState | None = None
         self.trivial_bound = None
         self.best_bound = None
-        self._superstep = jax.jit(self._superstep_impl)
         # per-phase jitted pieces of the superstep, built lazily the
         # first time telemetry phase timing runs (telemetry/; the fused
-        # _superstep above stays the only path when telemetry is off)
+        # _superstep property stays the only path when telemetry is off)
         self._phase_jits = None
         self.conv = None
 
@@ -311,35 +372,29 @@ class PHBase(SPOpt):
         return self.trivial_bound
 
     # -- one PH iteration, fully fused ------------------------------------
+    # The body lives in the module-level `ph_superstep`: everything
+    # that varies per run is a traced ARG (not a closure constant) —
+    # multihost meshes forbid closing over arrays that span
+    # non-addressable devices, bound-rewriting extensions swap
+    # batches/preps without recompiling, and the serve layer executes
+    # the same function with swapped-in scenario arrays.  This method
+    # stays as the un-jitted entry for callers holding a PH instance.
     def _superstep_impl(self, state: PHState, rho, W_on, prox_on,
                         lb=None, ub=None, eps=None, prep=None,
                         batch=None):
-        # batch as a traced ARG (not a closure constant): multihost
-        # meshes forbid closing over arrays that span non-addressable
-        # devices, and passing it also lets bound-rewriting extensions
-        # swap batches without recompiling
         b = self.batch if batch is None else batch
-        lb = b.lb if lb is None else lb
-        ub = b.ub if ub is None else ub
-        # prep as a traced ARG (not a closure constant): extensions
-        # that edit constraint data (cross-scenario cuts) re-prepare
-        # and the superstep picks it up without recompiling
-        prep = self.prep if prep is None else prep
-        c_eff, q_eff = ph_objective_arrays(
-            b, state.W, rho, state.xbar, W_on=W_on, prox_on=prox_on)
-        res = self.solver._solve_jit(
-            prep, c_eff, q_eff, lb, ub, b.obj_const,
-            state.x, state.y, None, eps)
-        x_na = b.nonants(res.x)
-        xbar, xsqbar = compute_xbar(b, x_na)
-        W = update_W(state.W, rho, x_na, xbar)
-        conv = convergence_metric(b, x_na, xbar)
-        # report the TRUE objective at x (c, not c_eff)
-        obj = b.objective(res.x)
-        return PHState(
-            x=res.x, y=res.y, W=W, xbar=xbar, xsqbar=xsqbar,
-            obj=obj, dual_obj=res.dual_obj, conv=conv, it=state.it + 1,
-            solve_iters=res.iters)
+        return ph_superstep(
+            self.solver, state, rho, W_on, prox_on,
+            b.lb if lb is None else lb,
+            b.ub if ub is None else ub,
+            eps, self.prep if prep is None else prep, b)
+
+    @property
+    def _superstep(self):
+        # resolved per CALLING thread (see _SUPERSTEP_TLS above): in the
+        # threaded wheel the hub's driving thread is not the thread
+        # that constructed it, and the wrapper must belong to the driver
+        return fused_superstep(self.solver)
 
     @property
     def superstep_eps(self):

@@ -27,7 +27,7 @@ from .bounds import BoundGuard
 from .chaos import ChaosError, ChaosInjector
 from .checkpoint import (checkpoint_exists, load_run_checkpoint,
                          restore_hub, save_run_checkpoint)
-from .supervisor import SpokeSupervisor
+from .supervisor import SpokeSupervisor, restart_delay
 
 
 def wheel_counters(opt_or_hub):
@@ -46,6 +46,6 @@ def wheel_counters(opt_or_hub):
 
 __all__ = [
     "BoundGuard", "ChaosError", "ChaosInjector", "SpokeSupervisor",
-    "checkpoint_exists", "load_run_checkpoint", "restore_hub",
-    "save_run_checkpoint", "wheel_counters",
+    "checkpoint_exists", "load_run_checkpoint", "restart_delay",
+    "restore_hub", "save_run_checkpoint", "wheel_counters",
 ]

@@ -48,6 +48,13 @@ from .. import telemetry as _telemetry
 LIVE, WAITING, STOPPED, FAILED = "live", "waiting", "stopped", "failed"
 
 
+def restart_delay(n, backoff, cap):
+    """Capped exponential backoff before the n-th restart (n >= 1) —
+    the single restart-pacing policy, shared by SpokeSupervisor and
+    the serve layer's worker supervision (serve/service.py)."""
+    return min(backoff * 2.0 ** (n - 1), cap)
+
+
 def _log_tail(proc, max_lines=15):
     lp = getattr(proc, "log_path", None)
     if lp and os.path.exists(lp):
@@ -182,8 +189,8 @@ class SpokeSupervisor:
         if self.restarts[i] < self.max_restarts:
             self.restarts[i] += 1
             self.spoke_restarts += 1
-            delay = min(self.backoff * 2.0 ** (self.restarts[i] - 1),
-                        self.backoff_cap)
+            delay = restart_delay(self.restarts[i], self.backoff,
+                                  self.backoff_cap)
             self._next_restart[i] = time.monotonic() + delay
             self.state[i] = WAITING
             self._tel.event("supervisor.restart", spoke=i, reason=reason,

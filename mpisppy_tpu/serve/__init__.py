@@ -1,0 +1,43 @@
+"""Solver-as-a-service: a persistent in-process solver layer.
+
+Modules (doc/src/serve.md is the operator-facing chapter):
+
+  * `compile_cache` — shape-bucketed compile cache: one executable per
+    (model, scenario count, stage dims, dtype, backend, solver config)
+    bucket, deduplicated through the thread-scoped jit registries
+    (phbase.fused_superstep / ops.pdhg.shared_solve_jit), plus AOT
+    `jit(vmap(superstep)).lower().compile()` executables for coalesced
+    batches;
+  * `service` — SolverService: bounded queue, admission control,
+    deadline handling (structured timeout results, never a hang), a
+    dispatch loop that coalesces same-bucket requests into one
+    vmap-batched execution, and SpokeSupervisor-style worker
+    supervision (chaos-injectable, capped-backoff restarts);
+  * `api` — submit/poll/result handles + synchronous solve() over a
+    process-global service;
+  * `request` — jax-free request/result envelope types.
+
+Importing this package (or `serve.api`) never imports jax; the service
+machinery loads on first use.
+"""
+
+from .api import (RequestHandle, get_service, poll, result,  # noqa: F401
+                  shutdown_service, solve, start_service, submit)
+
+__all__ = [
+    "RequestHandle", "SolverService", "CompileCache", "bucket_key",
+    "get_service", "poll", "result", "shutdown_service", "solve",
+    "start_service", "submit",
+]
+
+
+def __getattr__(name):
+    # lazy heavyweights: SolverService/CompileCache pull in the full
+    # optimizer stack (and jax) — resolved only when actually used
+    if name == "SolverService":
+        from .service import SolverService
+        return SolverService
+    if name in ("CompileCache", "bucket_key"):
+        from . import compile_cache as _cc
+        return getattr(_cc, name)
+    raise AttributeError(name)

@@ -1,0 +1,78 @@
+"""Client API for the serve layer: submit / poll / result handles plus
+a synchronous solve() wrapper over a process-global SolverService.
+
+IMPORT CONTRACT: importing this module touches neither jax nor the
+service machinery — clients embed it for free (AST-guarded in
+tests/test_serve.py, the telemetry-guard pattern).  The heavy imports
+happen inside `start_service` on first use.
+
+    from mpisppy_tpu.serve import api
+
+    h = api.submit(batch, {"defaultPHrho": 1.0})  # returns instantly
+    api.poll(h)                                    # "queued"/"running"/...
+    res = api.result(h, timeout=60)                # structured, never hangs
+
+    res = api.solve(batch, opts)                   # submit+result in one
+    # res["conv"], res["eobj"], res["trivial_bound"]: the same values
+    # PH.ph_main returns (bitwise identical at batch=1)
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .request import RequestHandle  # noqa: F401  (re-export, jax-free)
+
+_service = None
+_lock = threading.Lock()
+
+
+def start_service(options=None):
+    """Start (or return) the process-global SolverService.  `options`
+    only applies when the service is first created."""
+    global _service
+    with _lock:
+        if _service is None:
+            from .service import SolverService
+            _service = SolverService(options)
+    return _service.start()
+
+
+def get_service():
+    """The process-global service, or None if never started."""
+    return _service
+
+
+def submit(batch, options=None, **kwargs):
+    """Enqueue a solve on the global service; returns a RequestHandle."""
+    return start_service().submit(batch, options, **kwargs)
+
+
+def poll(handle):
+    s = _service
+    if s is None:
+        return "unknown"
+    return s.poll(handle)
+
+
+def result(handle, timeout=None):
+    s = _service
+    if s is None:
+        return {"status": "unknown", "request_id": handle.id}
+    return s.result(handle, timeout=timeout)
+
+
+def solve(batch, options=None, **kwargs):
+    """Synchronous convenience wrapper: the result dict carries the
+    same solution values as `PH.ph_main` (see PH.solution_dict)."""
+    return start_service().solve(batch, options, **kwargs)
+
+
+def shutdown_service(timeout=60.0):
+    """Drain and stop the global service (a later call starts a fresh
+    one)."""
+    global _service
+    with _lock:
+        s, _service = _service, None
+    if s is not None:
+        s.shutdown(timeout)

@@ -1,0 +1,150 @@
+"""Shape-bucketed compile cache for the serve layer.
+
+Requests are assigned to a BUCKET — the tuple of everything that
+determines the lowered PH superstep computation: model identity
+(name + static var/nonant names), scenario count, stage dims, constraint
+matrix kind, dtype, backend, and the solver config.  Two requests in
+the same bucket differ only in ARRAY VALUES (scenario data, rho,
+bounds, tolerance), which are all traced arguments of
+`phbase.ph_superstep` — so one compiled executable serves both, and a
+group of them can run as one vmap-batched execution.
+
+Per bucket this module holds:
+  * the canonical `PDHGSolver` (built once via `from_options`, so its
+    shared solve jit — ops.pdhg.shared_solve_jit — is warm for every
+    PH constructed for requests in the bucket);
+  * `superstep` — the thread-shared jitted superstep
+    (`phbase.fused_superstep`): the identical lowered computation a
+    standalone `PH.ph_main` runs (same pure function, same solver
+    config, same shapes), which makes the serve batch=1 result
+    bitwise-identical to a standalone run;
+  * per-batch-width AOT executables (`jax.jit(jax.vmap(...)).lower()
+    .compile()`) for the coalesced B>1 path.
+
+The cache also counts `serve.compile_cache.{hit,miss}` per REQUEST
+(telemetry counters when enabled, plain ints always) — the acceptance
+signal "N concurrent same-shape requests, one compilation".  Wire-up
+to jax's PERSISTENT compilation cache (warm process restarts skip XLA)
+is `utils.platform.enable_compile_cache`, called from
+`SolverService.start`.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .. import telemetry as _telemetry
+
+
+def solver_config(options):
+    """The bucket's solver-config component: the same hashable key the
+    process-wide jit registries use (PDHGSolver.config_key of the
+    solver `from_options` would build)."""
+    from ..ops.pdhg import PDHGSolver
+    return PDHGSolver.from_options(options).config_key()
+
+
+def bucket_key(batch, options=None, model=None, backend=None):
+    """Shape-bucket key for one request.
+
+    `model` defaults to the batch's static var/nonant names — a
+    structural fingerprint that separates models which happen to share
+    shapes; pass an explicit model name to pin it symbolically."""
+    import jax
+
+    if backend is None:
+        backend = jax.default_backend()
+    ident = model if model is not None else (
+        batch.var_names, batch.tree.nonant_names)
+    akind = ("split" if batch.split_A
+             else "shared" if batch.shared_A else "dense")
+    return (
+        ident,
+        int(batch.num_scens),
+        int(batch.num_vars),
+        int(batch.num_rows),
+        int(batch.num_nonants),
+        int(batch.tree.num_nodes),
+        akind,
+        str(batch.c.dtype),
+        str(backend),
+        solver_config(options),
+        # prep STRUCTURE flag: split-vs-dense prepared matrices change
+        # the argument treedef, so they cannot share an executable
+        bool((options or {}).get("no_split_prep", False)),
+    )
+
+
+class CompiledBucket:
+    """One bucket's executables (see module docstring).  Built lazily
+    by the service's single dispatch thread, so `fused_superstep`'s
+    thread-local registry resolves to that thread's wrapper; the
+    bucket object itself is only ever driven from the dispatch
+    thread (sequentially across worker restarts)."""
+
+    def __init__(self, key, options):
+        from ..ops.pdhg import PDHGSolver
+        from ..phbase import fused_superstep
+        self.key = key
+        self.solver = PDHGSolver.from_options(options)
+        self.superstep = fused_superstep(self.solver)
+        self._batched = {}            # B -> AOT-compiled executable
+        self._lock = threading.Lock()
+        self.aot_compiles = 0
+
+    def batched_superstep(self, example_args):
+        """AOT executable of `vmap(ph_superstep)` over a leading
+        request axis, lowered+compiled once per batch width B from the
+        stacked `example_args` (the superstep's 9 positional args, each
+        leaf with a leading B axis)."""
+        import functools
+
+        import jax
+
+        from ..phbase import ph_superstep
+
+        B = int(example_args[1].shape[0])     # rho: (B, S, K)
+        with self._lock:
+            exe = self._batched.get(B)
+        if exe is not None:
+            return exe
+        fn = jax.jit(jax.vmap(functools.partial(ph_superstep, self.solver)))
+        exe = fn.lower(*example_args).compile()
+        with self._lock:
+            if B not in self._batched:
+                self._batched[B] = exe
+                self.aot_compiles += 1
+        return self._batched[B]
+
+
+class CompileCache:
+    """Bucket table + per-request hit/miss accounting."""
+
+    def __init__(self, tel=None):
+        self._tel = tel if tel is not None else _telemetry.get()
+        self._buckets = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, batch, options=None, model=None):
+        """The CompiledBucket for one request (building it on first
+        sight of the bucket).  Counts one hit or miss per call — call
+        it once per request, not once per dispatch group."""
+        key = bucket_key(batch, options, model=model)
+        with self._lock:
+            entry = self._buckets.get(key)
+            if entry is None:
+                entry = CompiledBucket(key, options)
+                self._buckets[key] = entry
+                self.misses += 1
+                self._tel.counter("serve.compile_cache.miss").inc()
+            else:
+                self.hits += 1
+                self._tel.counter("serve.compile_cache.hit").inc()
+        return entry
+
+    def stats(self):
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "buckets": len(self._buckets)}

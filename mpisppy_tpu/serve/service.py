@@ -1,0 +1,476 @@
+"""SolverService — a persistent in-process solver service.
+
+The one-shot batch-job shape (`WheelSpinner` / driver scripts) pays
+backend init + XLA compiles per invocation and exits.  This service
+keeps the process (and its jit caches + AOT executables) alive and
+feeds it a queue of solve requests:
+
+  client --submit()--> bounded queue --dispatch thread--> PH solves
+          <--handle--                                      |
+          <--poll/result (structured, never hangs) --------+
+
+Dispatch (`_next_group`) pops the oldest request and COALESCES every
+queued request in the same shape bucket (compile_cache.bucket_key)
+with it, up to `serve_max_batch`.  A group of one runs exactly the
+standalone `PH` path — the identical lowered superstep computation
+`PH.ph_main` runs, so the result is bitwise identical (the api.py
+parity guarantee).  A larger group runs Iter0 per request, then drives ALL
+requests through ONE vmap-batched AOT superstep executable in
+lockstep, swapping each finished request's state out on the host while
+the rest keep iterating (finished elements keep computing inside the
+batch — wasted lanes, bounded by `serve_max_batch`, the price of one
+dispatch per iteration for the whole group).
+
+Supervision mirrors resilience.SpokeSupervisor, adapted to a thread
+worker: a crash (including injected `ChaosError` via
+`options["chaos"]` — each dispatched group is one chaos "step", and
+`crash_at_iter` counts dispatches) requeues the in-flight requests
+(per-request attempt budget), restarts the dispatch thread after the
+shared capped-exponential `restart_delay`, and fails the whole service
+once the restart budget is spent — every queued request then gets a
+structured FAILED result, and later submits are rejected.  A HUNG
+worker (chaos `hang_at_step`) is covered by per-request deadlines:
+`result()` is always time-bounded.
+
+Options (all prefixed `serve_`):
+  serve_max_queue       queue capacity, rejects beyond       (256)
+  serve_max_inflight    queued+running admission cap         (32)
+  serve_max_batch       max coalesced requests per dispatch  (8)
+  serve_default_deadline  per-request seconds (None = none)  (None)
+  serve_result_timeout  result() wait when no deadline       (600)
+  serve_result_grace    extra result() wait past deadline    (30)
+  serve_max_attempts    executions per request before FAILED (2)
+  serve_max_restarts    worker restarts before service FAILED(2)
+  serve_restart_backoff / serve_restart_backoff_cap          (0.1/5)
+plus the standard `telemetry` and `chaos` keys.
+
+Metrics (doc/src/serve.md): serve.queue_depth gauge,
+serve.batch_size / serve.request_seconds histograms,
+serve.compile_cache.{hit,miss} / serve.requests.* /
+serve.worker_restarts counters, serve.request + serve.dispatch spans.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+from .. import global_toc
+from .. import telemetry as _telemetry
+from ..resilience.chaos import ChaosError, ChaosInjector
+from ..resilience.supervisor import restart_delay
+from . import compile_cache as _cc
+from .request import (FAILED, OK, QUEUED, REJECTED, RUNNING, RequestHandle,
+                      SolveRequest, failed_result, rejected_result,
+                      timeout_result)
+
+
+class SolverService:
+    def __init__(self, options=None, cache=None):
+        o = dict(options or {})
+        self.options = o
+        self.max_queue = int(o.get("serve_max_queue", 256))
+        self.max_inflight = int(o.get("serve_max_inflight", 32))
+        self.max_batch = int(o.get("serve_max_batch", 8))
+        self.default_deadline = o.get("serve_default_deadline")
+        self.result_timeout = float(o.get("serve_result_timeout", 600.0))
+        self.result_grace = float(o.get("serve_result_grace", 30.0))
+        self.max_attempts = int(o.get("serve_max_attempts", 2))
+        self.max_restarts = int(o.get("serve_max_restarts", 2))
+        self.backoff = float(o.get("serve_restart_backoff", 0.1))
+        self.backoff_cap = float(o.get("serve_restart_backoff_cap", 5.0))
+        self._tel = _telemetry.configure_from_options(o.get("telemetry"))
+        self._chaos = ChaosInjector.from_options(o.get("chaos"))
+        self.cache = cache if cache is not None else _cc.CompileCache(
+            self._tel)
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queue = deque()
+        self._requests = {}           # id -> SolveRequest
+        self._results = {}            # id -> result dict
+        self._inflight = []           # requests popped, not yet finished
+        self._processing = 0
+        self._ids = itertools.count(1)
+        self._dispatches = 0
+        self._stopped = False
+        self._failed = None           # terminal service failure reason
+        self.restarts = 0
+        self._worker = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        """Start the dispatch thread (idempotent).  Also wires jax's
+        persistent compilation cache so a warm process restart skips
+        XLA entirely (utils.platform.enable_compile_cache)."""
+        with self._lock:
+            if self._failed is not None:
+                return self
+            running = self._worker is not None and self._worker.is_alive()
+        if not running:
+            from ..utils.platform import enable_compile_cache
+            enable_compile_cache()
+            self._spawn_worker()
+        return self
+
+    def _spawn_worker(self):
+        t = threading.Thread(target=self._worker_main,
+                             name="serve-dispatch", daemon=True)
+        self._worker = t
+        t.start()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def shutdown(self, timeout=60.0):
+        """Drain: the worker finishes the queue, then exits.  Anything
+        still queued after `timeout` is rejected."""
+        with self._work:
+            self._stopped = True
+            self._work.notify_all()
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout)
+        with self._lock:
+            for req in list(self._queue):
+                self._finish_locked(req, rejected_result(req.id, "shutdown"))
+            self._queue.clear()
+
+    # -- client API -------------------------------------------------------
+    def submit(self, batch, options=None, scenario_names=None,
+               deadline=None, model=None):
+        """Enqueue one solve; returns a RequestHandle immediately.
+        Admission control rejects (structured result, status
+        "rejected") instead of blocking: full queue, inflight cap, a
+        failed service, or a shut-down service."""
+        now = time.monotonic()
+        dl = deadline if deadline is not None else self.default_deadline
+        with self._work:
+            req = SolveRequest(
+                id=next(self._ids), batch=batch,
+                options=dict(options or {}),
+                scenario_names=scenario_names, model=model,
+                deadline=(now + float(dl)) if dl is not None else None,
+                submitted=now)
+            self._requests[req.id] = req
+            reason = None
+            if self._failed is not None:
+                reason = "service_failed"
+            elif self._stopped:
+                reason = "shutdown"
+            elif len(self._queue) >= self.max_queue:
+                reason = "queue_full"
+            elif len(self._queue) + self._processing >= self.max_inflight:
+                reason = "max_inflight"
+            if reason is not None:
+                self._finish_locked(req, rejected_result(req.id, reason))
+                return RequestHandle(req.id)
+            self._queue.append(req)
+            self._tel.counter("serve.requests.submitted").inc()
+            self._tel.gauge("serve.queue_depth").set(len(self._queue))
+            self._tel.event("serve.submit", request=req.id)
+            self._work.notify()
+        return RequestHandle(req.id)
+
+    def poll(self, handle):
+        """Current status string for the handle ("unknown" for an id
+        this service never issued)."""
+        with self._lock:
+            req = self._requests.get(handle.id)
+            return "unknown" if req is None else req.status
+
+    def result(self, handle, timeout=None):
+        """Block for the result — ALWAYS time-bounded: by `timeout`,
+        else by the request deadline + serve_result_grace, else by
+        serve_result_timeout.  An expired wait returns a structured
+        timeout snapshot WITHOUT finishing the request (a late
+        completion still lands; ask again)."""
+        req = self._requests.get(handle.id)
+        if req is None:
+            return {"status": "unknown", "request_id": handle.id}
+        if timeout is None:
+            if req.deadline is not None:
+                timeout = max(req.deadline - time.monotonic(), 0.0) \
+                    + self.result_grace
+            else:
+                timeout = self.result_timeout
+        if not req.done.wait(timeout):
+            return timeout_result(req, where="result_wait")
+        return self._results[req.id]
+
+    def solve(self, batch, options=None, scenario_names=None,
+              deadline=None, timeout=None, model=None):
+        """Synchronous convenience wrapper: submit + result.  On
+        success the dict carries the same values `PH.ph_main` returns
+        (PH.solution_dict keys)."""
+        self.start()
+        h = self.submit(batch, options, scenario_names=scenario_names,
+                        deadline=deadline, model=model)
+        return self.result(h, timeout=timeout)
+
+    # -- completion bookkeeping -------------------------------------------
+    def _finish_locked(self, req, res):
+        if req.done.is_set():
+            return
+        if req.status == RUNNING:
+            self._processing -= 1
+        if req in self._inflight:
+            self._inflight.remove(req)
+        req.status = res["status"]
+        self._results[req.id] = res
+        req.done.set()
+        self._tel.counter(f"serve.requests.{res['status']}").inc()
+        self._tel.histogram("serve.request_seconds").observe(
+            time.monotonic() - req.submitted)
+        self._tel.event("serve.done", request=req.id,
+                        status=res["status"])
+
+    def _finish(self, req, res):
+        with self._lock:
+            self._finish_locked(req, res)
+
+    # -- dispatch thread --------------------------------------------------
+    def _worker_main(self):
+        try:
+            while True:
+                group = self._next_group()
+                if group is None:
+                    return
+                self._process_group(group)
+        except Exception as exc:     # includes injected ChaosError
+            self._on_worker_crash(exc)
+
+    def _bucket(self, req):
+        if req.bucket is None:
+            req.bucket = _cc.bucket_key(req.batch, req.options,
+                                        model=req.model)
+        return req.bucket
+
+    def _next_group(self):
+        """Pop the oldest live request plus every same-bucket queued
+        request (up to max_batch), preserving queue order for the
+        rest.  Returns None only on drained shutdown."""
+        with self._work:
+            while True:
+                now = time.monotonic()
+                for req in [r for r in self._queue if r.expired(now)]:
+                    self._queue.remove(req)
+                    self._finish_locked(
+                        req, timeout_result(req, where="queued"))
+                if self._queue:
+                    break
+                if self._stopped:
+                    return None
+                self._work.wait(0.25)
+            head = self._queue.popleft()
+            group = [head]
+            skipped = []
+            while self._queue and len(group) < self.max_batch:
+                r = self._queue.popleft()
+                if self._bucket(r) == self._bucket(head):
+                    group.append(r)
+                else:
+                    skipped.append(r)
+            self._queue.extendleft(reversed(skipped))
+            for r in group:
+                r.status = RUNNING
+                self._inflight.append(r)
+            self._processing += len(group)
+            self._tel.gauge("serve.queue_depth").set(len(self._queue))
+        return group
+
+    def _process_group(self, group):
+        self._dispatches += 1
+        # chaos: each dispatched group is one "step" (crash/hang from
+        # step N on); crash_at_iter counts dispatches and fires EXACTLY
+        # once — the restart-and-recover test shape
+        self._chaos.step_tick()
+        self._chaos.hub_iter_tick(self._dispatches)
+        self._tel.histogram("serve.batch_size").observe(len(group))
+        try:
+            with self._tel.span("serve.dispatch", batch=len(group)):
+                self._execute_group(group)
+        except ChaosError:
+            raise
+        except Exception as exc:     # model/solver bug: fail the group,
+            for req in group:        # keep the service alive
+                self._finish(req, failed_result(req.id, repr(exc)))
+        # no inflight cleanup here: _finish_locked removes each request
+        # as it reaches a terminal state, and a ChaosError propagating
+        # past this frame MUST leave the group in _inflight so the
+        # crash handler can requeue it
+
+    def _on_worker_crash(self, exc):
+        global_toc(f"WARNING: serve dispatch worker crashed: {exc!r}")
+        self._tel.event("serve.worker_crash", error=repr(exc))
+        with self._lock:
+            for req in list(self._inflight):
+                req.attempts += 1
+                if req.attempts >= self.max_attempts:
+                    self._finish_locked(req, failed_result(
+                        req.id, f"worker crashed ({exc!r}) and the "
+                                f"attempt budget ({self.max_attempts}) "
+                                f"is spent", attempts=req.attempts))
+                else:
+                    self._processing -= 1
+                    req.status = QUEUED
+                    self._inflight.remove(req)
+                    self._queue.appendleft(req)
+            exhausted = self.restarts >= self.max_restarts
+            if exhausted:
+                self._failed = (f"worker crashed {self.restarts + 1} "
+                                f"times (restart budget "
+                                f"{self.max_restarts}): {exc!r}")
+                for req in list(self._queue):
+                    self._finish_locked(
+                        req, failed_result(req.id, self._failed))
+                self._queue.clear()
+            else:
+                self.restarts += 1
+        if exhausted:
+            self._tel.event("serve.worker_prune", error=repr(exc))
+            global_toc(f"WARNING: serve service FAILED: {self._failed}")
+            return
+        delay = restart_delay(self.restarts, self.backoff,
+                              self.backoff_cap)
+        self._tel.counter("serve.worker_restarts").inc()
+        self._tel.event("serve.worker_restart", incarnation=self.restarts,
+                        delay=delay)
+        global_toc(f"WARNING: serve worker restart "
+                   f"{self.restarts}/{self.max_restarts} in {delay:.2f}s")
+        time.sleep(delay)
+        with self._lock:
+            if self._stopped:
+                return
+        self._spawn_worker()
+
+    # -- execution --------------------------------------------------------
+    def _build_ph(self, req):
+        from ..opt.ph import PH
+        names = req.scenario_names
+        if names is None:
+            names = [f"scen{i}" for i in range(req.batch.num_scens)]
+        return PH(dict(req.options), list(names), batch=req.batch)
+
+    def _execute_group(self, group):
+        live = []
+        for req in group:
+            if req.expired():
+                self._finish(req, timeout_result(req, where="dispatch"))
+                continue
+            try:
+                with self._tel.span("serve.request", request=req.id):
+                    ph = self._build_ph(req)
+                    engine = self.cache.get(req.batch, req.options,
+                                            model=req.model)
+                    ph.Iter0()
+            except Exception as exc:  # e.g. certified-infeasible iter0
+                self._finish(req, failed_result(req.id, repr(exc)))
+                continue
+            live.append((req, ph))
+        if not live:
+            return
+        if len(live) == 1:
+            self._run_single(*live[0])
+        else:
+            self._run_batched(live, engine)
+
+    def _run_single(self, req, ph):
+        """One request: the standalone PH path itself.  `iterk_loop`
+        drives the fused superstep — the identical lowered computation
+        `PH.ph_main` runs — so this result is bitwise equal to a
+        standalone run (parity test in tests/test_serve.py).  A
+        deadline swaps in an equivalent loop with a per-iteration
+        clock check."""
+        if req.deadline is None:
+            ph.iterk_loop()
+        else:
+            max_iters = int(ph.options.get("PHIterLimit", 100))
+            convthresh = float(ph.options.get("convthresh", 1e-4))
+            for k in range(int(ph.state.it) + 1, max_iters + 1):
+                if req.expired():
+                    self._finish(req, timeout_result(
+                        req, where="iteration",
+                        iterations=int(ph.state.it), conv=ph.conv))
+                    return
+                if ph.ph_iteration() < convthresh:
+                    break
+        self._finish_ok(req, ph)
+
+    def _run_batched(self, live, engine):
+        """Coalesced same-bucket requests in one vmap-batched AOT
+        superstep executable, lockstep; each request leaves the batch
+        (host-side state capture) at ITS stopping iteration."""
+        import jax
+        import numpy as np
+
+        reqs = [req for req, _ in live]
+        phs = [ph for _, ph in live]
+        dtype = phs[0].batch.c.dtype
+
+        def stack(trees):
+            # flatten/unflatten (NOT tree_map over multiple trees):
+            # meta equality on model_meta numpy arrays is ill-defined,
+            # but same-bucket treedefs are structurally identical
+            flat = [jax.tree_util.tree_flatten(t) for t in trees]
+            treedef = flat[0][1]
+            import jax.numpy as jnp
+            return jax.tree_util.tree_unflatten(
+                treedef,
+                [jnp.stack(leaves) for leaves in
+                 zip(*[f[0] for f in flat])])
+
+        def unstack(tree, i):
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            return jax.tree_util.tree_unflatten(
+                treedef, [leaf[i] for leaf in leaves])
+
+        import jax.numpy as jnp
+        args = (
+            stack([ph.state for ph in phs]),
+            jnp.stack([ph.rho for ph in phs]),
+            jnp.asarray([ph.W_on for ph in phs], dtype),
+            jnp.asarray([ph.prox_on for ph in phs], dtype),
+            jnp.stack([ph.lb_eff for ph in phs]),
+            jnp.stack([ph.ub_eff for ph in phs]),
+            jnp.stack([jnp.asarray(ph.superstep_eps, dtype)
+                       for ph in phs]),
+            stack([ph.prep for ph in phs]),
+            stack([ph.batch for ph in phs]),
+        )
+        exe = engine.batched_superstep(args)
+        state, rest = args[0], args[1:]
+        limits = [int(ph.options.get("PHIterLimit", 100)) for ph in phs]
+        threshes = [float(ph.options.get("convthresh", 1e-4))
+                    for ph in phs]
+        iters = [int(ph.state.it) for ph in phs]
+        active = set(range(len(phs)))
+        while active:
+            state = exe(state, *rest)
+            jax.block_until_ready(state.conv)
+            convs = np.asarray(state.conv)
+            now = time.monotonic()
+            for i in sorted(active):
+                iters[i] += 1
+                req, ph = reqs[i], phs[i]
+                if convs[i] < threshes[i] or iters[i] >= limits[i]:
+                    ph.state = unstack(state, i)
+                    ph.conv = float(convs[i])
+                    active.discard(i)
+                    self._finish_ok(req, ph)
+                elif req.deadline is not None and now > req.deadline:
+                    active.discard(i)
+                    self._finish(req, timeout_result(
+                        req, where="iteration", iterations=iters[i],
+                        conv=float(convs[i])))
+
+    def _finish_ok(self, req, ph):
+        res = ph.solution_dict()
+        res["status"] = OK
+        res["request_id"] = req.id
+        res["wall_s"] = time.monotonic() - req.submitted
+        self._finish(req, res)

@@ -41,15 +41,7 @@ class SPOpt(SPBase):
     def __init__(self, *args, prep=None, **kwargs):
         super().__init__(*args, **kwargs)
         o = self.options
-        self.solver = PDHGSolver(
-            max_iters=int(o.get("pdhg_max_iters", 20000)),
-            eps=float(o.get("pdhg_eps", 1e-6)),
-            check_every=int(o.get("pdhg_check_every", 40)),
-            restart_every=int(o.get("pdhg_restart_every", 16)),
-            use_pallas=o.get("pdhg_use_pallas", "auto"),
-            pallas_tile=int(o.get("pdhg_pallas_tile", 8)),
-            pallas_interpret=bool(o.get("pdhg_pallas_interpret", False)),
-        )
+        self.solver = PDHGSolver.from_options(o)
         if prep is not None:
             # shared PreparedBatch from a sibling cylinder over the SAME
             # batch (WheelSpinner passes the hub's — Ruiz scaling and the

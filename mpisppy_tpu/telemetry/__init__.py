@@ -203,3 +203,16 @@ def traffic_counters(registry=None):
     vals = ({k: c.value for k, c in reg._counters.items()}
             if reg.enabled else {})
     return {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
+
+
+def serve_counters(registry=None):
+    """Serve-layer counter dict for bench JSON (zeros when the run had
+    telemetry off — keys are stable either way)."""
+    reg = registry if registry is not None else get().registry
+    names = ("serve.requests.submitted", "serve.requests.ok",
+             "serve.requests.timeout", "serve.requests.rejected",
+             "serve.requests.failed", "serve.compile_cache.hit",
+             "serve.compile_cache.miss", "serve.worker_restarts")
+    vals = ({k: c.value for k, c in reg._counters.items()}
+            if reg.enabled else {})
+    return {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}

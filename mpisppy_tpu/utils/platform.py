@@ -23,27 +23,44 @@ def ensure_cpu_backend(force=False):
     jax.config.update("jax_platforms", "cpu")
 
 
-def enable_compile_cache_if_cpu():
-    """Point jax at a persistent compilation cache when running on the
-    CPU backend (measured: repeat sizes-3 MIP runs drop 80.8 s ->
-    49.3 s — ~30 s of the wall is XLA compiles).  Accelerator runs are
-    left alone (their compile path may be remote/plugin-managed), and
-    an explicit JAX_COMPILATION_CACHE_DIR always wins."""
+def enable_compile_cache():
+    """Point jax at a persistent compilation cache so warm restarts
+    skip XLA (measured on CPU: repeat sizes-3 MIP runs drop 80.8 s ->
+    49.3 s — ~30 s of the wall is compiles).
+
+    Policy, most-specific wins:
+      * an explicit JAX_COMPILATION_CACHE_DIR is jax's own knob and is
+        never overridden;
+      * MPISPPY_TPU_COMPILE_CACHE_DIR enables the cache at that path on
+        EVERY backend — the serve layer's warm-restart contract
+        (doc/src/serve.md);
+      * otherwise the historical conservative default: CPU only
+        (accelerator compile paths may be remote/plugin-managed), under
+        MPISPPY_TPU_JAX_CACHE or ~/.cache/mpisppy_tpu_jax.
+
+    Returns the cache dir in effect, or None when left disabled."""
     import jax
 
-    if jax.devices()[0].platform != "cpu":
-        return
     if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        return
-    path = os.environ.get(
-        "MPISPPY_TPU_JAX_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache",
-                     "mpisppy_tpu_jax"))
+        return os.environ["JAX_COMPILATION_CACHE_DIR"]
+    path = os.environ.get("MPISPPY_TPU_COMPILE_CACHE_DIR")
+    if not path:
+        if jax.devices()[0].platform != "cpu":
+            return None
+        path = os.environ.get(
+            "MPISPPY_TPU_JAX_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "mpisppy_tpu_jax"))
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
+        return path
     except (OSError, AttributeError):   # read-only home / old jax
-        pass
+        return None
+
+
+# historical name (examples/_driver.py and external callers)
+enable_compile_cache_if_cpu = enable_compile_cache
 
 
 def enable_x64_scope():

@@ -35,3 +35,7 @@ def pytest_configure(config):
         "markers", "telemetry: observability-layer tests (tracing, "
         "metrics, trace export); these RUN under tier-1's "
         "`-m 'not slow'`")
+    config.addinivalue_line(
+        "markers", "serve: solver-as-a-service layer tests (compile "
+        "cache, coalescing, admission control, parity); these RUN "
+        "under tier-1's `-m 'not slow'`")
