@@ -89,6 +89,58 @@ def _probe_once(timeout_s):
         return "down"
 
 
+def _probe_cache_path():
+    """Cache file for TERMINAL probe verdicts.  BENCH_PROBE_CACHE
+    overrides the location; "0" (or empty) disables caching."""
+    p = os.environ.get("BENCH_PROBE_CACHE")
+    if p == "0" or p == "":
+        return None
+    if p:
+        return p
+    import tempfile
+    return os.path.join(tempfile.gettempdir(),
+                        "mpisppy_tpu_bench_probe.json")
+
+
+def _probe_cache_key():
+    """The backend-environment fingerprint a cached verdict is valid
+    for: anything that could change which backend jax discovers."""
+    keys = ("JAX_PLATFORMS", "PJRT_DEVICE", "TPU_NAME",
+            "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID")
+    return "|".join(f"{k}={os.environ.get(k, '')}" for k in keys)
+
+
+def _probe_cache_get():
+    path = _probe_cache_path()
+    if path is None:
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f).get(_probe_cache_key())
+    except (OSError, ValueError):
+        return None
+
+
+def _probe_cache_put(verdict):
+    path = _probe_cache_path()
+    if path is None:
+        return
+    try:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            data = {}
+        data[_probe_cache_key()] = {"verdict": verdict,
+                                    "ts": time.time()}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def _fight_for_chip(deadline):
     """Probe until `deadline` (time.time() value): the tunnel wedges
     TRANSIENTLY (round 2 got through; rounds 1/3 gave up after one
@@ -99,11 +151,25 @@ def _fight_for_chip(deadline):
     the first such probe ends the fight (the r05 fix), and
     MPISPPY_TPU_BENCH_SKIP_PROBE=1 skips probing entirely (CI boxes
     that know they have no chip go straight to the CPU path).
+
+    TERMINAL verdicts ("cpu": the box can never produce an
+    accelerator; "up": a chip answered) are PERSISTED to a small cache
+    file keyed on the backend environment, so repeated bench runs on
+    the same box don't re-burn the ~930s probe budget re-discovering
+    the same CPU fallback (r05 spent 6 failed probes there).  "down"
+    (transient) is never cached.  BENCH_PROBE_CACHE=0 disables;
+    MPISPPY_TPU_BENCH_SKIP_PROBE=1 still overrides everything.
     Returns (alive, attempts)."""
     if os.environ.get("MPISPPY_TPU_BENCH_SKIP_PROBE") == "1":
         return False, 0
     if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
         return False, 0
+    cached = _probe_cache_get()
+    if cached is not None and cached.get("verdict") in ("cpu", "up"):
+        v = cached["verdict"]
+        print(f"[bench] cached probe verdict '{v}' for this backend "
+              f"env (BENCH_PROBE_CACHE=0 to re-probe)", file=sys.stderr)
+        return v == "up", 0
     wait = float(os.environ.get("BENCH_PROBE_WAIT", 60))
     timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
     attempt = 0
@@ -112,11 +178,13 @@ def _fight_for_chip(deadline):
         verdict = _probe_once(
             min(timeout_s, max(deadline - time.time(), 5)))
         if verdict == "up":
+            _probe_cache_put("up")
             return True, attempt
         if verdict == "cpu":
             print(f"[bench] probe {attempt} healthy but CPU-only: no "
                   f"accelerator on this box, skipping the remaining "
                   f"probe budget", file=sys.stderr)
+            _probe_cache_put("cpu")
             return False, attempt
         remaining = deadline - time.time()
         print(f"[bench] accelerator probe {attempt} failed "
@@ -519,6 +587,20 @@ def worker():
         # only).  0/unset = uncapped.  Measured S=250 CPU: cheaper
         # checks but +6 iterations — a wash; kept as a tuning lever.
         opts["lagrangian_iters_cap"] = int(os.environ["BENCH_LAG_CAP"])
+    if os.environ.get("BENCH_EPS_LADDER", "1") != "0":
+        # inexactness ladder: early PH supersteps solve loosely (1e-3)
+        # and tighten with the PH convergence metric down to the r05
+        # static 1e-4 — never past that floor, so the late iterations
+        # (and the certified bounds, which use pdhg_eps) are unchanged.
+        # BENCH_EPS_LADDER=0 reverts to the static superstep_eps for
+        # A/B runs.
+        opts["eps_ladder"] = {"start": 1e-3, "min": 1e-4, "couple": 0.1}
+    if float(os.environ.get("BENCH_COMPACT", 0) or 0) > 0:
+        # opt-in converged-scenario compaction for the solve_loop
+        # callers (Iter0 / xhat / Lagrangian); the fused PH superstep
+        # is unaffected.  e.g. BENCH_COMPACT=0.5 halves the slab when
+        # at most half the scenarios are still active.
+        opts["pdhg_compact_threshold"] = float(os.environ["BENCH_COMPACT"])
     ph = PH(opts, [f"scen{i}" for i in range(S)], batch=b)
 
     # warm up compiles (excluded: reference baseline excludes Gurobi
@@ -529,14 +611,17 @@ def worker():
     warm_eps = 1e6
     saved_eps = ph.solver_eps
     saved_ss = ph._superstep_eps_opt
+    saved_lad = ph._ladder
     ph.solver_eps = jnp.asarray(warm_eps, b.c.dtype)
     ph._superstep_eps_opt = warm_eps
+    ph._ladder = None  # the ladder eps would shadow the warmup eps
     ph.Iter0()
     ph.ph_iteration()
     ph.evaluate_xhat(ph.root_xbar())
     ph.lagrangian_bound(eps=warm_eps)
     ph.solver_eps = saved_eps
     ph._superstep_eps_opt = saved_ss
+    ph._ladder = saved_lad
 
     ph.clear_warmstart()
     ph.reset_solve_stats()
@@ -582,6 +667,22 @@ def worker():
         "certify_frac": round(stats["certify_wall_s"] / max(wall, 1e-9),
                               4),
     }
+    # adaptive-work counters (ops/pdhg adaptive restarts, compaction,
+    # eps ladder) for the timed region — spopt.pdhg_stats().  The
+    # trajectory is compressed to its (width, active) change points so
+    # the JSON line stays one line.
+    ps = ph.pdhg_stats()
+    traj = [t for i, t in enumerate(ps["active_fraction_traj"])
+            if i == 0 or (t["width"], t["active"]) !=
+            (ps["active_fraction_traj"][i - 1]["width"],
+             ps["active_fraction_traj"][i - 1]["active"])]
+    extra.update({
+        "inner_iters": ps["inner_iters"],
+        "restarts_total": ps["restarts_total"],
+        "active_fraction_final": round(ps["active_fraction_final"], 4),
+        "active_fraction_traj": traj,
+        "flops_saved_tflops": round(ps["flops_saved"] / 1e12, 4),
+    })
     extra.update(_telemetry_extras(ph))
     if fallback_sized:
         extra["note_size"] = ("accelerator unavailable: CPU fallback "

@@ -84,8 +84,13 @@ def fused_chunk(A, cs, qs, lbs, ubs, rlo, rhi, x, y, tau, sigma,
     `steps` implementation.
     """
     S, M, N = A.shape
-    if S % tile_s:
-        tile_s = 1
+    # shrink the tile to the largest divisor of S <= tile_s by halving:
+    # compacted slabs (PDHGSolver.solve_compacted) arrive at power-of-
+    # two widths, so a pow2 tile_s degrades gracefully (8 -> 4 -> 2)
+    # instead of collapsing straight to 1 whenever S % tile_s != 0
+    tile_s = max(1, min(int(tile_s), S))
+    while S % tile_s:
+        tile_s -= 1 if tile_s % 2 else tile_s // 2
     grid = (S // tile_s,)
     t2 = tau[:, None]
     s2 = sigma[:, None]

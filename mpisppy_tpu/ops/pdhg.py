@@ -20,11 +20,18 @@ reference phbase.py:617 attach_PH_to_objective).
 Method: Chambolle-Pock / Condat-Vu primal-dual iterations with
   * Ruiz equilibration of A (done once per batch in `prepare_batch`),
   * step sizes from a power-iteration estimate of ||A||_2,
-  * fixed-frequency restart to the running average iterate, keeping
-    whichever of {current, average} has the smaller KKT error
-    (the PDLP restart scheme, simplified),
+  * KKT-progress-triggered ADAPTIVE restarts to the better of
+    {current, running average} (the PDLP/MPAX trigger: restart on
+    sufficient KKT-score decay or on necessary-decay-plus-stagnation,
+    per scenario, with the fixed cadence kept as both the forced
+    cycle-length cap and a documented fallback mode —
+    `restart_mode="fixed"`),
   * primal-weight (omega) rebalancing at restarts,
-  * per-scenario convergence freezing.
+  * per-scenario convergence freezing, and (opt-in) host-driven
+    COMPACTION of the surviving scenarios into smaller power-of-two
+    width buckets once most of the batch has converged
+    (`solve_compacted`), so late iterations stop paying matvec FLOPs
+    and HBM bandwidth for frozen scenarios.
 
 Termination mirrors PDLP's relative KKT criterion.  Duals: `y` are the
 row multipliers; `reduced costs` follow from c + qdiag*x + A^T y, giving
@@ -35,6 +42,7 @@ cylinders/lagrangian_bounder.py) for free — see `dual_objective`.
 from __future__ import annotations
 
 import dataclasses
+import os as _os
 import threading as _threading
 from functools import partial
 from typing import Any
@@ -111,11 +119,12 @@ class SolveResult:
     gap: Any        # (S,) relative primal-dual gap
     converged: Any  # (S,) bool
     iters: Any      # () int - iterations used (max across batch)
+    restarts: Any = 0  # (S,) int - restart events per scenario
 
 
 _register(SolveResult,
           ("x", "y", "obj", "dual_obj", "pres", "dres", "gap",
-           "converged", "iters"))
+           "converged", "iters", "restarts"))
 
 
 # --------------------------------------------------------------------------
@@ -260,6 +269,28 @@ def _prepare_split_core(A0, rows, cols, vals, row_lo, row_hi,
     )
 
 
+def _gather_prep(prep: PreparedBatch, ii) -> PreparedBatch:
+    """Gather a PreparedBatch down to the scenario rows `ii`.
+
+    Shared-A leaves (leading dim 1, the broadcasting convention of
+    prepare_batch_split / shared prep) are NOT gathered — they apply
+    to every scenario already; a SplitA gathers only its per-scenario
+    delta values.  Used by `PDHGSolver.solve_compacted`."""
+    def take(a):
+        return a if a.shape[0] == 1 else a[ii]
+
+    A = prep.A
+    if isinstance(A, SplitA):
+        A = SplitA(shared=A.shared, rows=A.rows, cols=A.cols,
+                   vals=A.vals[ii])
+    else:
+        A = take(A)
+    return PreparedBatch(
+        A=A, row_lo=take(prep.row_lo), row_hi=take(prep.row_hi),
+        d_row=take(prep.d_row), d_col=take(prep.d_col),
+        anorm=take(prep.anorm))
+
+
 def _unscale_A(A, dr, dc):
     """User-space view of a scaled constraint operator: A / dr / dc,
     dispatching on representation (dense batched / shared / SplitA)."""
@@ -351,16 +382,20 @@ def _residuals(x, y, c, qdiag, A, row_lo, row_hi, lb, ub, cavg=None):
 class _Carry:
     x: Any
     y: Any
-    x_sum: Any      # running sums for the restart average
+    x_sum: Any           # running sums for the restart average
     y_sum: Any
-    nsum: Any       # scalar count in current restart cycle
-    x_last: Any     # iterate at last restart (for omega update)
+    nsum: Any            # (S,) count in current restart cycle
+    x_last: Any          # iterate at last restart (for omega update)
     y_last: Any
-    omega: Any      # (S,) primal weight
-    k: Any          # iteration counter
-    converged: Any  # (S,) bool
-    x_best: Any     # frozen solution for converged scenarios
+    omega: Any           # (S,) primal weight
+    k: Any               # iteration counter (outer checks)
+    converged: Any       # (S,) bool
+    x_best: Any          # frozen solution for converged scenarios
     y_best: Any
+    cycle: Any           # (S,) checks since last restart
+    score_restart: Any   # (S,) KKT score of the last restart point
+    score_cand_prev: Any  # (S,) candidate score at previous check
+    restarts: Any        # (S,) restart events taken
 
 
 _register(_Carry, tuple(f.name for f in dataclasses.fields(_Carry)))
@@ -410,19 +445,38 @@ class PDHGSolver:
 
     def __init__(self, max_iters=20000, eps=1e-6, check_every=40,
                  restart_every=16, omega0=1.0, use_pallas="auto",
-                 pallas_tile=8, pallas_interpret=False):
+                 pallas_tile=8, pallas_interpret=False,
+                 restart_mode="adaptive", restart_beta_sufficient=0.2,
+                 restart_beta_necessary=0.8, compact_threshold=0.0):
         # restart_every is in units of `check_every` inner iterations.
+        # Under restart_mode="adaptive" it is the FORCED cycle-length
+        # cap (a restart fires at the latest every restart_every
+        # checks); under restart_mode="fixed" it is the whole policy.
         # Default 16 (=640 inner iterations per restart cycle):
-        # measured on the model corpus, every-4 restarts CYCLE on
+        # measured on the model corpus, every-4 FIXED restarts CYCLE on
         # degenerate duals (unit commitment: 24/40 scenarios stuck at
         # gap ~1 after 300k iters; at 16 all converge in 12k) and are
         # ~2x slower on farmer; sizes/sslp/netdes/battery are
         # insensitive (within ~2x of their small iteration counts).
+        # The adaptive trigger restarts EARLIER than the cap only on
+        # evidence of sufficient KKT decay, so it cannot reintroduce
+        # that cycling.
         self.max_iters = int(max_iters)
         self.eps = float(eps)
         self.check_every = int(check_every)
         self.restart_every = int(restart_every)
         self.omega0 = float(omega0)
+        if restart_mode not in ("adaptive", "fixed"):
+            raise ValueError(
+                f"restart_mode must be 'adaptive' or 'fixed', "
+                f"got {restart_mode!r}")
+        self.restart_mode = str(restart_mode)
+        self.restart_beta_sufficient = float(restart_beta_sufficient)
+        self.restart_beta_necessary = float(restart_beta_necessary)
+        # active fraction below which solve_compacted gathers the
+        # unconverged survivors into a smaller pow2 width bucket;
+        # 0.0 disables compaction (solve_compacted == solve)
+        self.compact_threshold = float(compact_threshold)
         if use_pallas == "auto":
             # measured on TPU v5e (farmer-64, crops_mult 4): XLA's
             # fused while_loop beats the Pallas chunk kernel ~100x at
@@ -449,8 +503,20 @@ class PDHGSolver:
         keys).  The one place the option names/defaults are mapped —
         SPOpt and the serve layer's compile cache both route through
         here so a request's bucket is keyed on the exact solver config
-        the in-process optimizer would use."""
-        o = options or {}
+        the in-process optimizer would use.
+
+        The MPISPPY_TPU_PDHG environment variable overlays the dict
+        (env wins, matching the chaos/telemetry layering): a
+        space-separated key=value string of pdhg knobs with or without
+        the pdhg_ prefix, e.g.
+        ``MPISPPY_TPU_PDHG="restart_mode=fixed compact_threshold=0.25"``.
+        """
+        o = dict(options or {})
+        env = _os.environ.get("MPISPPY_TPU_PDHG")
+        if env:
+            from ..utils.solver_spec import option_string_to_dict
+            for k, v in (option_string_to_dict(env) or {}).items():
+                o[k if k.startswith("pdhg_") else f"pdhg_{k}"] = v
         return cls(
             max_iters=int(o.get("pdhg_max_iters", 20000)),
             eps=float(o.get("pdhg_eps", 1e-6)),
@@ -458,15 +524,46 @@ class PDHGSolver:
             restart_every=int(o.get("pdhg_restart_every", 16)),
             use_pallas=o.get("pdhg_use_pallas", "auto"),
             pallas_tile=int(o.get("pdhg_pallas_tile", 8)),
-            pallas_interpret=bool(o.get("pdhg_pallas_interpret", False)))
+            pallas_interpret=bool(o.get("pdhg_pallas_interpret", False)),
+            restart_mode=str(o.get("pdhg_restart_mode", "adaptive")),
+            restart_beta_sufficient=float(
+                o.get("pdhg_restart_beta_sufficient", 0.2)),
+            restart_beta_necessary=float(
+                o.get("pdhg_restart_beta_necessary", 0.8)),
+            compact_threshold=float(o.get("pdhg_compact_threshold", 0.0)))
 
     def config_key(self):
         """Hashable construction-time config.  `_solve_impl` reads ONLY
         these attributes, so two solvers with equal keys trace to the
-        same computation and may share one jit wrapper."""
+        same computation and may share one jit wrapper.
+        (compact_threshold does not enter the trace — solve_compacted
+        is a host-side driver — but it is part of the key so configs
+        with different compaction policies never alias in caches keyed
+        on it, e.g. serve.compile_cache.bucket_key.)"""
         return (self.max_iters, self.eps, self.check_every,
                 self.restart_every, self.omega0, self.use_pallas,
-                self.pallas_tile, self.pallas_interpret)
+                self.pallas_tile, self.pallas_interpret,
+                self.restart_mode, self.restart_beta_sufficient,
+                self.restart_beta_necessary, self.compact_threshold)
+
+    def clone(self, **overrides):
+        """A new solver with this one's full config, selected fields
+        overridden — the safe way for callers that re-solve under a
+        different budget/precision (spopt._certified_resolve,
+        opt.mip._dive_solver) to keep every OTHER knob (restart policy,
+        betas, pallas config) in sync with the parent solver."""
+        cfg = dict(
+            max_iters=self.max_iters, eps=self.eps,
+            check_every=self.check_every,
+            restart_every=self.restart_every, omega0=self.omega0,
+            use_pallas=self.use_pallas, pallas_tile=self.pallas_tile,
+            pallas_interpret=self.pallas_interpret,
+            restart_mode=self.restart_mode,
+            restart_beta_sufficient=self.restart_beta_sufficient,
+            restart_beta_necessary=self.restart_beta_necessary,
+            compact_threshold=self.compact_threshold)
+        cfg.update(overrides)
+        return type(self)(**cfg)
 
     # -- public ----------------------------------------------------------
     def solve(self, prep: PreparedBatch, c, qdiag, lb, ub,
@@ -490,6 +587,127 @@ class PDHGSolver:
             y0 = jnp.zeros((S, M), c.dtype)
         return self._solve_jit(prep, c, qdiag, lb, ub, obj_const, x0, y0,
                                consensus, eps, iters_cap)
+
+    def solve_compacted(self, prep: PreparedBatch, c, qdiag, lb, ub,
+                        obj_const=None, x0=None, y0=None,
+                        consensus: ConsensusSpec | None = None,
+                        eps=None, probs=None, segment_iters=None,
+                        on_segment=None) -> SolveResult:
+        """`solve`, segmented on the host so converged scenarios stop
+        paying matvec FLOPs: run `segment_iters` inner iterations via
+        the traced `iters_cap` (no recompile per segment), read the
+        converged mask back, and once the active (unconverged, prob>0)
+        fraction drops below `compact_threshold`, GATHER the survivors
+        into the next smaller power-of-two width bucket
+        (serve.compile_cache.width_bucket — pow2 quantization bounds
+        the number of distinct compiled widths at log2(S)) and continue
+        the hot loop on the compacted slab, scattering results back
+        over the frozen full-width buffers.
+
+        Scenarios that converge are NEVER re-entered into a later
+        segment, so anything frozen before the first compaction is
+        bit-identical to the uncompacted solve (same jit, same shapes,
+        same inputs up to its convergence check).  Survivors restart
+        each segment from their own warm iterate; their restart average
+        and omega re-seed, so they agree with the uncompacted solve
+        only up to the KKT tolerance — the compaction parity contract.
+
+        Falls back to plain `solve` when compaction is disabled
+        (compact_threshold == 0) or under a ConsensusSpec (consensus
+        couples the whole batch; dropping scenarios would change the
+        problem).  `probs`: optional (S,) scenario probabilities —
+        zero-probability padding rows never count as active.
+        `on_segment`: optional callback receiving a dict
+        (width/active/iters/seg_iters) after each segment — the
+        telemetry hook for the active-fraction trajectory.
+        """
+        if self.compact_threshold <= 0.0 or consensus is not None:
+            return self.solve(prep, c, qdiag, lb, ub,
+                              obj_const=obj_const, x0=x0, y0=y0,
+                              consensus=consensus, eps=eps)
+        import numpy as np
+
+        from ..serve.compile_cache import width_bucket
+
+        S, N = c.shape
+        M = prep.A.shape[1]
+        if obj_const is None:
+            obj_const = jnp.zeros((S,), c.dtype)
+        if x0 is None:
+            x0 = jnp.zeros((S, N), c.dtype)
+        if y0 is None:
+            y0 = jnp.zeros((S, M), c.dtype)
+        seg = (int(segment_iters) if segment_iters
+               else self.check_every * self.restart_every)
+        seg = max(seg, self.check_every)
+
+        real = np.arange(S)
+        if probs is not None:
+            p = np.asarray(probs).reshape(-1)
+            real = real[p > 0]
+
+        bufs = None          # full-width result buffers (set by seg 1)
+        restarts_f = jnp.zeros((S,), jnp.int32)
+        iters_done = 0
+        width = S
+        cur = None           # None = full width, else gathered indices
+        cur_n = S            # how many leading rows of `cur` are real
+        while True:
+            cap = min(seg, self.max_iters - iters_done)
+            if cap < self.check_every and bufs is not None:
+                break
+            if cur is None:
+                res = self.solve(prep, c, qdiag, lb, ub,
+                                 obj_const=obj_const, x0=x0, y0=y0,
+                                 eps=eps, iters_cap=cap)
+            else:
+                ii = jnp.asarray(cur, jnp.int32)
+                res = self.solve(
+                    _gather_prep(prep, ii), c[ii], qdiag[ii], lb[ii],
+                    ub[ii], obj_const=obj_const[ii],
+                    x0=bufs["x"][ii], y0=bufs["y"][ii],
+                    eps=eps, iters_cap=cap)
+            iters_done += int(res.iters)
+            if bufs is None:
+                bufs = {f: getattr(res, f) for f in
+                        ("x", "y", "obj", "dual_obj", "pres", "dres",
+                         "gap", "converged")}
+                restarts_f = res.restarts
+            else:
+                ri = jnp.asarray(cur[:cur_n], jnp.int32)
+                for f in bufs:
+                    bufs[f] = bufs[f].at[ri].set(
+                        getattr(res, f)[:cur_n])
+                restarts_f = restarts_f.at[ri].add(res.restarts[:cur_n])
+
+            conv = np.asarray(bufs["converged"])
+            act = real[~conv[real]]
+            if on_segment is not None:
+                on_segment({"width": int(width),
+                            "active": int(act.size),
+                            "iters": iters_done,
+                            "seg_iters": int(res.iters)})
+            if act.size == 0 or iters_done >= self.max_iters:
+                break
+            target = width_bucket(act.size)
+            if target < width and act.size <= self.compact_threshold * width:
+                width = target
+            # survivors only — converged rows are frozen in `bufs` and
+            # must never re-enter a segment (bit-stability contract);
+            # pad to the bucket width by repeating survivors (padded
+            # duplicates converge with their twins and are dropped at
+            # scatter time)
+            cur = np.resize(act, width)
+            cur[:act.size] = act
+            cur_n = int(act.size)
+
+        return SolveResult(
+            x=bufs["x"], y=bufs["y"], obj=bufs["obj"],
+            dual_obj=bufs["dual_obj"], pres=bufs["pres"],
+            dres=bufs["dres"], gap=bufs["gap"],
+            converged=bufs["converged"],
+            iters=jnp.asarray(iters_done, jnp.int32),
+            restarts=restarts_f)
 
     # -- impl --------------------------------------------------------
     def _solve_impl(self, prep, c, qdiag, lb, ub, obj_const, x0, y0,
@@ -651,66 +869,117 @@ class PDHGSolver:
                 (newly & ~carry.converged)[:, None], y, carry.y_best)
 
             k = carry.k + 1
-            do_restart = (k % self.restart_every) == 0
+            cycle = carry.cycle + 1
 
-            def restart(_):
-                xa = x_sum / nsum
-                ya = y_sum / nsum
-                score_avg, *_ = kkt_score(xa, ya)
-                take_avg = score_avg < score_cur
-                xr = jnp.where(take_avg[:, None], xa, x)
-                yr = jnp.where(take_avg[:, None], ya, y)
-                # primal weight update (PDLP eq. (10)-style smoothing)
-                if consensus is not None:
-                    # one shared problem PER COPY -> one shared omega
-                    # per copy (per-scenario omegas would give
-                    # inconsistent step sizes and break the
-                    # shared-variable invariant)
-                    dxv = xr - carry.x_last
-                    dyv = yr - carry.y_last
-                    dx = jnp.sqrt(scen_sum(jnp.sum(dxv * dxv, axis=1)))
-                    dy = jnp.sqrt(scen_sum(jnp.sum(dyv * dyv, axis=1)))
-                else:
-                    dx = jnp.linalg.norm(xr - carry.x_last, axis=1)
-                    dy = jnp.linalg.norm(yr - carry.y_last, axis=1)
-                ok = (dx > 1e-12) & (dy > 1e-12)
-                ratio = jnp.where(ok, dy / jnp.maximum(dx, 1e-12), 1.0)
-                omega = jnp.where(
-                    ok,
-                    jnp.exp(0.5 * jnp.log(ratio)
-                            + 0.5 * jnp.log(carry.omega)),
-                    carry.omega)
-                omega = jnp.clip(omega, 1e-4, 1e4)
-                z = jnp.zeros_like(x)
-                return xr, yr, z, jnp.zeros_like(y), 0.0, xr, yr, omega
+            # restart CANDIDATE: the better of {current, cycle average}
+            # (PDLP's restart-to-the-best rule).  Computed every check
+            # — one extra kkt_score per check_every inner iterations,
+            # ~2.5% at the default cadence — so the adaptive trigger
+            # can observe the candidate's score.
+            xa = x_sum / nsum[:, None]
+            ya = y_sum / nsum[:, None]
+            score_avg, *_ = kkt_score(xa, ya)
+            take_avg = score_avg < score_cur
+            xr = jnp.where(take_avg[:, None], xa, x)
+            yr = jnp.where(take_avg[:, None], ya, y)
+            score_cand = jnp.minimum(score_avg, score_cur)
 
-            def norestart(_):
-                return (x, y, x_sum, y_sum, nsum,
-                        carry.x_last, carry.y_last, carry.omega)
+            if self.restart_mode == "adaptive":
+                # PDLP trigger, per scenario: sufficient decay fires
+                # immediately; necessary decay fires only once progress
+                # WITHIN the cycle stalls (candidate score no longer
+                # improving check-over-check); the fixed cadence
+                # remains as a forced cap so no cycle runs unbounded.
+                # Under consensus every input here is per-copy uniform
+                # (kkt_score reduces with scen_max/scen_sum), so the
+                # mask is per-copy uniform too and the shared-variable
+                # invariant holds.
+                suff = (score_cand
+                        <= self.restart_beta_sufficient
+                        * carry.score_restart)
+                nec = ((score_cand
+                        <= self.restart_beta_necessary
+                        * carry.score_restart)
+                       & (score_cand > carry.score_cand_prev))
+                do_restart = suff | nec | (cycle >= self.restart_every)
+            else:
+                do_restart = jnp.broadcast_to(
+                    cycle >= self.restart_every, cycle.shape)
+            # frozen scenarios take no further restarts (their state is
+            # pinned below anyway; keeps the restarts counter honest)
+            do_restart = do_restart & ~conv
 
-            (xr, yr, xsr, ysr, nsr, xl, yl, om) = lax.cond(
-                do_restart, restart, norestart, None)
+            # primal weight update (PDLP eq. (10)-style smoothing)
+            if consensus is not None:
+                # one shared problem PER COPY -> one shared omega
+                # per copy (per-scenario omegas would give
+                # inconsistent step sizes and break the
+                # shared-variable invariant)
+                dxv = xr - carry.x_last
+                dyv = yr - carry.y_last
+                dx = jnp.sqrt(scen_sum(jnp.sum(dxv * dxv, axis=1)))
+                dy = jnp.sqrt(scen_sum(jnp.sum(dyv * dyv, axis=1)))
+            else:
+                dx = jnp.linalg.norm(xr - carry.x_last, axis=1)
+                dy = jnp.linalg.norm(yr - carry.y_last, axis=1)
+            ok = (dx > 1e-12) & (dy > 1e-12)
+            ratio = jnp.where(ok, dy / jnp.maximum(dx, 1e-12), 1.0)
+            om_new = jnp.where(
+                ok,
+                jnp.exp(0.5 * jnp.log(ratio)
+                        + 0.5 * jnp.log(carry.omega)),
+                carry.omega)
+            om_new = jnp.clip(om_new, 1e-4, 1e4)
+
+            # apply the restart per scenario via masks (no batch-global
+            # lax.cond: scenarios restart independently)
+            m = do_restart
+            m2 = m[:, None]
+            zx = jnp.zeros_like(x)
+            zy = jnp.zeros_like(y)
+            xr_ = jnp.where(m2, xr, x)
+            yr_ = jnp.where(m2, yr, y)
 
             # freeze converged scenarios
             cm = carry.converged[:, None]
             return _Carry(
-                x=jnp.where(cm, carry.x, xr),
-                y=jnp.where(cm, carry.y, yr),
-                x_sum=xsr, y_sum=ysr, nsum=nsr,
-                x_last=xl, y_last=yl, omega=om, k=k,
-                converged=conv, x_best=x_best, y_best=y_best)
+                x=jnp.where(cm, carry.x, xr_),
+                y=jnp.where(cm, carry.y, yr_),
+                x_sum=jnp.where(m2, zx, x_sum),
+                y_sum=jnp.where(m2, zy, y_sum),
+                nsum=jnp.where(m, 0.0, nsum),
+                x_last=jnp.where(m2, xr, carry.x_last),
+                y_last=jnp.where(m2, yr, carry.y_last),
+                omega=jnp.where(m, om_new, carry.omega), k=k,
+                converged=conv, x_best=x_best, y_best=y_best,
+                cycle=jnp.where(m, 0, cycle),
+                score_restart=jnp.where(m, score_cand,
+                                        carry.score_restart),
+                # reset to +inf at a restart so the stagnation test
+                # cannot fire on the new cycle's first check
+                score_cand_prev=jnp.where(m, jnp.inf, score_cand),
+                restarts=carry.restarts + m.astype(jnp.int32))
 
         S, N = cs.shape
         M = rlo.shape[1]
+        inf = jnp.full((S,), jnp.inf, cs.dtype)
+        # seed the decay reference with the WARM-START's own KKT score
+        # (not +inf, which would read any first check as "sufficient
+        # decay" and fire a spurious immediate restart)
+        score0, *_ = kkt_score(xs0, ys0)
         init = _Carry(
             x=xs0, y=ys0,
             x_sum=jnp.zeros_like(xs0), y_sum=jnp.zeros_like(ys0),
-            nsum=jnp.asarray(0.0, cs.dtype),
+            nsum=jnp.zeros((S,), cs.dtype),
             x_last=xs0, y_last=ys0,
             omega=jnp.full((S,), self.omega0, cs.dtype),
             k=jnp.asarray(0, jnp.int32),
             converged=jnp.zeros((S,), bool),
-            x_best=xs0, y_best=ys0)
+            x_best=xs0, y_best=ys0,
+            cycle=jnp.zeros((S,), jnp.int32),
+            score_restart=score0.astype(cs.dtype),
+            score_cand_prev=inf,
+            restarts=jnp.zeros((S,), jnp.int32))
         fin = lax.while_loop(cond, body, init)
 
         x = jnp.where(fin.converged[:, None], fin.x_best, fin.x)
@@ -735,4 +1004,4 @@ class PDHGSolver:
             pres=pres, dres=dres, gap=gap,
             converged=fin.converged | ((pres < eps) & (dres < eps)
                                        & (gap < eps)),
-            iters=fin.k * ne)
+            iters=fin.k * ne, restarts=fin.restarts)

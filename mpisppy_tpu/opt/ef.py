@@ -89,7 +89,7 @@ class ExtensiveForm(SPOpt):
         import jax
 
         from .. import global_toc
-        from ..ops.pdhg import PDHGSolver, prepare_batch
+        from ..ops.pdhg import prepare_batch
 
         b = self.batch
         p = np.asarray(b.prob, np.float64)[:, None]
@@ -113,8 +113,9 @@ class ExtensiveForm(SPOpt):
                    else (lambda a: jnp.asarray(np.asarray(a, np.float64))))
             prep64 = prepare_batch(put(b.A), put(b.row_lo), put(b.row_hi),
                                    shared_cols=True)
-            s64 = PDHGSolver(max_iters=max(self.solver.max_iters, 100000),
-                             eps=self.solver.eps)
+            s64 = self.solver.clone(
+                max_iters=max(self.solver.max_iters, 100000),
+                use_pallas=False)
             r64 = s64.solve(
                 prep64,
                 put(c),

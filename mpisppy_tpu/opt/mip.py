@@ -126,16 +126,9 @@ class ExtensiveFormMIP(ExtensiveForm):
         key = ("_dive_solver", max_iters)
         s = self._np_cache.get(key)
         if s is None:
-            from ..ops.pdhg import PDHGSolver
-            s = PDHGSolver(
-                max_iters=max_iters,
-                eps=self.solver.eps,
-                check_every=self.solver.check_every,
-                restart_every=self.solver.restart_every,
-                use_pallas=self.solver.use_pallas,
-                pallas_tile=self.solver.pallas_tile,
-                pallas_interpret=self.solver.pallas_interpret,
-                omega0=self.solver.omega0)
+            # clone: every knob (restart policy, betas, pallas config)
+            # stays in lockstep with the certified solver's config
+            s = self.solver.clone(max_iters=max_iters)
             self._np_cache[key] = s
         return s
 

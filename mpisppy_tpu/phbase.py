@@ -54,6 +54,8 @@ class PHState:
     conv: Any     # () convergence metric
     it: Any       # () int iteration count
     solve_iters: Any = 0  # () int kernel iterations of the last solve
+    active_frac: Any = 1.0  # () unconverged fraction (prob>0) last solve
+    solve_restarts: Any = 0  # () int restart events of the last solve
 
 
 _register(PHState, tuple(f.name for f in dataclasses.fields(PHState)))
@@ -128,6 +130,14 @@ def update_W(W, rho, x_na, xbar):
     return W + rho * (x_na - xbar)
 
 
+def _active_fraction(batch, converged):
+    """Fraction of prob>0 scenarios the solve left unconverged — the
+    adaptive-work observability signal (pdhg.active_fraction)."""
+    live = batch.prob > 0
+    n = jnp.maximum(jnp.sum(live), 1)
+    return jnp.sum((~converged) & live) / n
+
+
 def convergence_metric(batch: ScenarioBatch, x_na, xbar):
     """Scaled prob-weighted ||x - xbar||_1 (reference phbase.py:321-343
     convergence_diff)."""
@@ -162,7 +172,9 @@ def ph_superstep(solver, state: PHState, rho, W_on, prox_on,
     return PHState(
         x=res.x, y=res.y, W=W, xbar=xbar, xsqbar=xsqbar,
         obj=obj, dual_obj=res.dual_obj, conv=conv, it=state.it + 1,
-        solve_iters=res.iters)
+        solve_iters=res.iters,
+        active_frac=_active_fraction(batch, res.converged),
+        solve_restarts=jnp.sum(res.restarts))
 
 
 # Per-THREAD fused-superstep registry, mirroring
@@ -259,6 +271,31 @@ class PHBase(SPOpt):
         # certified bound solves — the analog of the reference's
         # iterk mipgap vs bound-solve gap split (extensions/mipgapper.py)
         self._superstep_eps_opt = self.options.get("superstep_eps")
+        # inexactness LADDER (options["eps_ladder"]): start the hot-loop
+        # solves LOOSE and tighten as PH's own convergence metric
+        # shrinks — early PH iterations over-solve subproblems the next
+        # W update will invalidate anyway (the adaptive-sampling-PH
+        # observation, PAPERS.md).  Config (truthy enables; a dict
+        # overrides fields):
+        #   start  — iteration-1 tolerance (default max(100*eps, 1e-3))
+        #   min    — tightest tolerance (default the solver eps)
+        #   couple — eps target = couple * conv (default 0.1): the
+        #       tolerance tracks the consensus error geometrically,
+        #       clamped to [min, start] and monotone non-increasing
+        # When enabled, the ladder REPLACES a static superstep_eps (it
+        # IS the dynamic schedule feeding the same traced-eps path, so
+        # tightening never recompiles).
+        lad = self.options.get("eps_ladder")
+        self._ladder = None
+        if lad:
+            lad = dict(lad) if isinstance(lad, dict) else {}
+            self._ladder = {
+                "start": float(lad.get(
+                    "start", max(100.0 * self.solver.eps, 1e-3))),
+                "min": float(lad.get("min", self.solver.eps)),
+                "couple": float(lad.get("couple", 0.1)),
+            }
+            self._ladder_eps = self._ladder["start"]
 
         # optional converger (reference phbase.py:726-755 PH_Prep wires
         # options["ph_converger"]; convergers/converger.py API)
@@ -359,7 +396,9 @@ class PHBase(SPOpt):
         self.state = PHState(
             x=res.x, y=res.y, W=W, xbar=xbar, xsqbar=xsqbar,
             obj=res.obj, dual_obj=res.dual_obj, conv=conv,
-            it=jnp.asarray(0, jnp.int32), solve_iters=res.iters)
+            it=jnp.asarray(0, jnp.int32), solve_iters=res.iters,
+            active_frac=_active_fraction(self.batch, res.converged),
+            solve_restarts=jnp.sum(res.restarts))
         self.conv = float(conv)
         global_toc(f"Iter0 trivial bound = {self.trivial_bound:.6g}, "
                    f"conv = {float(conv):.6g}")
@@ -398,9 +437,13 @@ class PHBase(SPOpt):
 
     @property
     def superstep_eps(self):
-        """Tolerance of the hot-loop subproblem solves: the
-        superstep_eps option when given, else the DYNAMIC solver_eps
-        (so the Gapper schedule keeps reaching the PH loop)."""
+        """Tolerance of the hot-loop subproblem solves: the eps-ladder
+        schedule when enabled (options["eps_ladder"], updated each
+        ph_iteration), else the superstep_eps option when given, else
+        the DYNAMIC solver_eps (so the Gapper schedule keeps reaching
+        the PH loop)."""
+        if self._ladder is not None:
+            return jnp.asarray(self._ladder_eps, self.batch.c.dtype)
         if self._superstep_eps_opt is None:
             return self.solver_eps
         return jnp.asarray(self._superstep_eps_opt, self.batch.c.dtype)
@@ -488,7 +531,9 @@ class PHBase(SPOpt):
         self.state = PHState(
             x=res.x, y=res.y, W=W, xbar=xbar, xsqbar=xsqbar,
             obj=obj, dual_obj=res.dual_obj, conv=conv, it=st.it + 1,
-            solve_iters=res.iters)
+            solve_iters=res.iters,
+            active_frac=_active_fraction(b, res.converged),
+            solve_restarts=jnp.sum(res.restarts))
 
     def ph_iteration(self):
         self._ext("pre_solve_loop")
@@ -504,19 +549,33 @@ class PHBase(SPOpt):
         # the conv readback below
         b = self.batch
         it_n = int(self.state.solve_iters)
+        rst_n = int(self.state.solve_restarts)
         self._flops += _mfu.pdhg_flops(
             it_n, b.num_scens, b.num_rows,
             b.num_vars, self.solver.check_every)
         self._kernel_iters += it_n
+        self._restarts_total += rst_n
+        self._active_fraction = float(self.state.active_frac)
         wall = time.time() - t0
         self._solve_wall += wall
         self._ext("post_solve_loop")
         self.conv = float(self.state.conv)
+        if self._ladder is not None:
+            # tighten (never loosen) toward couple*conv, floored at min
+            self._ladder_eps = min(
+                self._ladder_eps,
+                max(self._ladder["min"],
+                    self._ladder["couple"] * self.conv))
         if tel.enabled:
             r = tel.registry
             r.counter("ph.iterations").inc()
             r.histogram("ph.iteration_seconds").observe(wall)
             r.gauge("ph.conv").set(self.conv)
+            r.counter("pdhg.inner_iters_total").inc(it_n)
+            r.counter("pdhg.restarts_total").inc(rst_n)
+            r.gauge("pdhg.active_fraction").set(self._active_fraction)
+            if self._ladder is not None:
+                r.gauge("ph.superstep_eps").set(self._ladder_eps)
         return self.conv
 
     # -- crash-resume (resilience/checkpoint.py) --------------------------

@@ -2,7 +2,8 @@
 
 Extends the WXBarWriter W/xbar snapshot (`utils/wxbarutils.py`) into a
 complete PH run checkpoint: the whole `PHState` (x, y, W, xbar,
-xsqbar, obj, dual_obj, conv, it, solve_iters) plus the run-level
+xsqbar, obj, dual_obj, conv, it, solve_iters, active_frac,
+solve_restarts) plus the run-level
 scalars (trivial/best bound) and — when the optimizer runs under a
 hub — the hub's BestInnerBound/BestOuterBound and incumbent nonant
 solution.  Restoring the full state makes the resumed trajectory
@@ -57,6 +58,8 @@ def save_run_checkpoint(path, opt):
         "obj": np.asarray(st.obj), "dual_obj": np.asarray(st.dual_obj),
         "conv": np.float64(st.conv), "it": np.int64(st.it),
         "solve_iters": np.int64(st.solve_iters),
+        "active_frac": np.float64(st.active_frac),
+        "solve_restarts": np.int64(np.asarray(st.solve_restarts)),
         "trivial_bound": _opt_float(getattr(opt, "trivial_bound", None)),
         "best_bound": _opt_float(getattr(opt, "best_bound", None)),
         "nonant_names": (
@@ -112,7 +115,14 @@ def load_run_checkpoint(path, opt):
         dual_obj=jnp.asarray(z["dual_obj"], dt),
         conv=jnp.asarray(float(z["conv"]), dt),
         it=jnp.asarray(int(z["it"]), jnp.int32),
-        solve_iters=jnp.asarray(int(z["solve_iters"]), jnp.int32))
+        solve_iters=jnp.asarray(int(z["solve_iters"]), jnp.int32),
+        # fields added after the original format default when a
+        # pre-adaptive-work checkpoint is restored
+        active_frac=jnp.asarray(
+            float(z["active_frac"]) if "active_frac" in z else 1.0, dt),
+        solve_restarts=jnp.asarray(
+            int(z["solve_restarts"]) if "solve_restarts" in z else 0,
+            jnp.int32))
     opt.conv = float(z["conv"])
     opt.trivial_bound = _opt_load(z["trivial_bound"])
     opt.best_bound = _opt_load(z["best_bound"])

@@ -36,6 +36,18 @@ import threading
 from .. import telemetry as _telemetry
 
 
+def width_bucket(n, floor=1):
+    """Next power-of-two >= max(n, floor): THE width-bucketing rule.
+
+    Used by the serve layer's batch coalescing and by
+    `ops.pdhg.PDHGSolver.solve_compacted` when it gathers unconverged
+    survivors into a smaller slab — quantizing widths to powers of two
+    bounds the number of distinct compiled executables at log2(S) per
+    bucket instead of one per observed width."""
+    n = max(int(n), int(floor), 1)
+    return 1 << (n - 1).bit_length()
+
+
 def solver_config(options):
     """The bucket's solver-config component: the same hashable key the
     process-wide jit registries use (PDHGSolver.config_key of the

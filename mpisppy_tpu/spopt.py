@@ -80,6 +80,10 @@ class SPOpt(SPBase):
         self._solve_wall = 0.0     # accumulated timed solve seconds
         self._certify_wall = 0.0   # seconds inside f64 certified re-solves
         self._kernel_iters = 0     # accumulated PDHG kernel iterations
+        self._restarts_total = 0   # accumulated PDHG restart events
+        self._flops_saved = 0.0    # est. FLOPs avoided by compaction
+        self._active_traj = []     # last compacted solve's trajectory
+        self._active_fraction = 1.0  # last solve's final active fraction
         # telemetry (telemetry/): the options value configures the
         # process-global handle; every instrument lookup below is a
         # null no-op when disabled (zero-cost-when-off contract)
@@ -132,23 +136,48 @@ class SPOpt(SPBase):
             cache = self._named_warm.get(warm, (None, None))
         else:
             cache = (self._x_warm, self._y_warm) if warm else (None, None)
-        res = self.solver.solve(
-            self.prep,
-            b.c if c is None else c,
-            b.qdiag if qdiag is None else qdiag,
-            b.lb if lb is None else lb,
-            b.ub if ub is None else ub,
-            obj_const=b.obj_const,
-            x0=cache[0],
-            y0=cache[1],
-            eps=self.solver_eps if eps is None else eps,
-            iters_cap=iters_cap,
-        )
+        args = (self.prep,
+                b.c if c is None else c,
+                b.qdiag if qdiag is None else qdiag,
+                b.lb if lb is None else lb,
+                b.ub if ub is None else ub)
+        kw = dict(obj_const=b.obj_const, x0=cache[0], y0=cache[1],
+                  eps=self.solver_eps if eps is None else eps)
+        # compaction (opt-in via pdhg_compact_threshold) applies only
+        # to uncapped solves: an iters_cap caller is screening and owns
+        # its own budget/shape discipline
+        if self.solver.compact_threshold > 0.0 and iters_cap is None:
+            traj = []
+            res = self.solver.solve_compacted(
+                *args, **kw, probs=b.prob, on_segment=traj.append)
+            self._active_traj = traj
+            full = float(max(int(np.sum(np.asarray(b.prob) > 0)), 1))
+            self._active_fraction = (traj[-1]["active"] / full
+                                     if traj else 0.0)
+            # FLOPs the compacted segments did NOT spend on rows the
+            # full-width solve would have carried
+            saved = sum(
+                _mfu.pdhg_flops(t["seg_iters"],
+                                b.num_scens - t["width"],
+                                b.num_rows, b.num_vars,
+                                self.solver.check_every)
+                for t in traj if t["width"] < b.num_scens)
+            self._flops_saved += saved
+        else:
+            res = self.solver.solve(*args, **kw, iters_cap=iters_cap)
+            saved = 0.0
+            self._active_fraction = float(
+                np.sum(np.asarray(~res.converged)
+                       & (np.asarray(b.prob) > 0))
+                / max(int(np.sum(np.asarray(b.prob) > 0)), 1))
         it_n = int(res.iters)
+        rst_n = int(np.sum(np.asarray(res.restarts)))
+        # net of compaction savings: saved counts work NOT done
         self._flops += _mfu.pdhg_flops(
             it_n, b.num_scens, b.num_rows, b.num_vars,
-            self.solver.check_every)
+            self.solver.check_every) - saved
         self._kernel_iters += it_n
+        self._restarts_total += rst_n
         if certify:
             select = None
             if certify == "feas":
@@ -171,6 +200,22 @@ class SPOpt(SPBase):
             r.counter("solve.calls").inc()
             r.counter("solve.kernel_iters").inc(it_n)
             r.histogram("solve.seconds").observe(dt)
+            r.counter("pdhg.inner_iters_total").inc(it_n)
+            r.counter("pdhg.restarts_total").inc(rst_n)
+            r.gauge("pdhg.active_fraction").set(self._active_fraction)
+            r.gauge("pdhg.active_scenarios").set(
+                self._active_fraction
+                * int(np.sum(np.asarray(b.prob) > 0)))
+            if saved:
+                r.counter("pdhg.flops_saved").inc(saved)
+            if rst_n:
+                # mean restart cycle length in inner iterations: total
+                # iterate-steps taken across the batch over the number
+                # of cycles those steps were split into
+                r.event("pdhg.restart", count=rst_n,
+                        mean_cycle=it_n * b.num_scens / max(
+                            rst_n + b.num_scens, 1),
+                        iters=it_n)
             _mfu.record_to_registry(r, self._flops, self._solve_wall,
                                     kernel_iters=self._kernel_iters)
         if dtiming or self.options.get("display_timing"):
@@ -247,11 +292,12 @@ class SPOpt(SPBase):
             "certify_max_iters", max(self.solver.max_iters, 100000)))
         if self._solver64 is None or \
                 self._solver64.max_iters != cert_iters:
-            self._solver64 = PDHGSolver(
-                max_iters=cert_iters,
-                eps=self.solver.eps,
-                check_every=self.solver.check_every,
-                restart_every=self.solver.restart_every)
+            # clone: keeps the restart policy/betas (and every future
+            # knob) in lockstep with the fast solver's config; the f64
+            # fallback typically runs on host CPU, where the Pallas
+            # kernel has no business
+            self._solver64 = self.solver.clone(
+                max_iters=cert_iters, use_pallas=False)
         try:
             cpu = jax.devices("cpu")[0]
         except RuntimeError:
@@ -344,6 +390,10 @@ class SPOpt(SPBase):
         def scat(a, a64, d=dt):
             return a.at[ix].set(jnp.asarray(np.asarray(a64), d))
 
+        restarts = res.restarts
+        if getattr(restarts, "ndim", 0):     # (S,) array, not the
+            restarts = restarts.at[ix].add(  # scalar-0 pytree default
+                jnp.asarray(np.asarray(r64.restarts), restarts.dtype))
         return dataclasses.replace(
             res,
             x=scat(res.x, r64.x), y=scat(res.y, r64.y),
@@ -351,7 +401,8 @@ class SPOpt(SPBase):
             dual_obj=scat(res.dual_obj, r64.dual_obj),
             pres=scat(res.pres, r64.pres), dres=scat(res.dres, r64.dres),
             gap=scat(res.gap, r64.gap),
-            converged=scat(res.converged, r64.converged, bool))
+            converged=scat(res.converged, r64.converged, bool),
+            restarts=restarts)
 
     def clear_warmstart(self):
         self._x_warm = None
@@ -389,6 +440,25 @@ class SPOpt(SPBase):
         self._certify_wall = 0.0
         self._kernel_iters = 0
         self._solve_times = []
+        self._restarts_total = 0
+        self._flops_saved = 0.0
+        self._active_traj = []
+        self._active_fraction = 1.0
+
+    def pdhg_stats(self):
+        """Adaptive-work counters across all solve_loop calls since the
+        last reset: total inner iterations, restart events, estimated
+        FLOPs saved by compaction, the final active fraction, and the
+        last compacted solve's active-fraction trajectory (one entry
+        per segment).  bench.py surfaces these as `inner_iters` /
+        `active_fraction_final` / `active_fraction_traj`."""
+        return {
+            "inner_iters": int(self._kernel_iters),
+            "restarts_total": int(self._restarts_total),
+            "flops_saved": float(self._flops_saved),
+            "active_fraction_final": float(self._active_fraction),
+            "active_fraction_traj": list(self._active_traj),
+        }
 
     def solve_stats(self):
         """Accumulated kernel FLOPs / wall-clock / MFU across all

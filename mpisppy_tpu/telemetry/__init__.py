@@ -205,6 +205,21 @@ def traffic_counters(registry=None):
     return {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
 
 
+def pdhg_counters(registry=None):
+    """Inner-solver adaptive-work counters for bench JSON (zeros when
+    the run had telemetry off — keys are stable either way)."""
+    reg = registry if registry is not None else get().registry
+    names = ("pdhg.inner_iters_total", "pdhg.restarts_total",
+             "pdhg.flops_saved")
+    vals = ({k: c.value for k, c in reg._counters.items()}
+            if reg.enabled else {})
+    out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
+    g = (reg._gauges.get("pdhg.active_fraction")
+         if reg.enabled else None)
+    out["pdhg_active_fraction"] = float(g.value) if g is not None else 0.0
+    return out
+
+
 def serve_counters(registry=None):
     """Serve-layer counter dict for bench JSON (zeros when the run had
     telemetry off — keys are stable either way)."""

@@ -16,7 +16,9 @@ may also be given as ONE string of space-separated key=value pairs
 
 from __future__ import annotations
 
-KNOBS = ("eps", "max_iters", "check_every", "restart_every")
+KNOBS = ("eps", "max_iters", "check_every", "restart_every",
+         "restart_mode", "restart_beta_sufficient",
+         "restart_beta_necessary", "compact_threshold")
 
 
 def option_string_to_dict(ostr):

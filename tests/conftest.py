@@ -39,3 +39,7 @@ def pytest_configure(config):
         "markers", "serve: solver-as-a-service layer tests (compile "
         "cache, coalescing, admission control, parity); these RUN "
         "under tier-1's `-m 'not slow'`")
+    config.addinivalue_line(
+        "markers", "pdhg: adaptive-work solver tests (KKT-triggered "
+        "restarts, compaction, inexactness ladder, trace-safety "
+        "guard); these RUN under tier-1's `-m 'not slow'`")
