@@ -289,6 +289,7 @@ def worker_sslp():
     obj = ef.get_objective_value()
     dual = ef.get_dual_bound()
     gap = abs(obj - dual) / max(abs(obj), 1e-9)
+    stats = ef.solve_stats()
     out = {
         "metric": f"sslp_5_25_{S}_lp_ef_seconds_to_1pct_gap",
         "value": round(wall, 3) if gap <= 0.01 else -1,
@@ -296,6 +297,9 @@ def worker_sslp():
         "gap": round(float(gap), 6),
         "objective": round(float(obj), 3),
         "dual_bound": round(float(dual), 3),
+        "mfu": (round(stats["mfu"], 6) if stats["mfu"] is not None
+                else None),
+        "kernel_dtype": stats["dtype"],
         "device": ("TPU" if on_tpu else "cpu"), "scens": S}
     if gap > 0.01:
         out["note"] = f"gap {gap:.4f} above 1%"
@@ -452,6 +456,9 @@ def worker_uc():
         "ef_bound_s": round(ef_bound_s, 3),
         "mfu": (round(stats["mfu"], 6) if stats["mfu"] is not None
                 else None),
+        "kernel_dtype": stats["dtype"],
+        "hot_dtype": ph.pdhg_stats()["hot_dtype"],
+        "promotions_total": ph.pdhg_stats()["promotions_total"],
         "kernel_tflops": round(stats["flops"] / 1e12, 3),
         "device": stats["device"], "scens": S, "units": 3 * fm,
         "hours": H, "certify_s": round(stats["certify_wall_s"], 3),
@@ -601,6 +608,20 @@ def worker():
         # is unaffected.  e.g. BENCH_COMPACT=0.5 halves the slab when
         # at most half the scenarios are still active.
         opts["pdhg_compact_threshold"] = float(os.environ["BENCH_COMPACT"])
+    hot = os.environ.get("BENCH_HOT_DTYPE", "f32")
+    if hot not in ("", "0", "off", "none", "f64"):
+        # mixed-precision hot loop (default ON: f32).  The certified
+        # bound solves request pdhg_eps=1e-5, below the f32 eps floor
+        # (~1.2e-5), so they auto-PROMOTE to the full-precision pair
+        # while the supersteps (1e-4 and looser) stay hot; the f64
+        # certified re-solve path is precision-pinned regardless.
+        # BENCH_HOT_DTYPE=off reverts to the r05 full-precision run.
+        opts["pdhg_hot_dtype"] = hot
+    if float(os.environ.get("BENCH_SPARSE", 0) or 0) > 0:
+        # opt-in BCOO sparse shared-block matvecs for split preps:
+        # e.g. BENCH_SPARSE=0.3 routes through jax.experimental.sparse
+        # when the shared block is under 30% dense
+        opts["pdhg_sparse_threshold"] = float(os.environ["BENCH_SPARSE"])
     ph = PH(opts, [f"scen{i}" for i in range(S)], batch=b)
 
     # warm up compiles (excluded: reference baseline excludes Gurobi
@@ -682,6 +703,13 @@ def worker():
         "active_fraction_final": round(ps["active_fraction_final"], 4),
         "active_fraction_traj": traj,
         "flops_saved_tflops": round(ps["flops_saved"] / 1e12, 4),
+        # precision/sparsity state of the timed region (PR 6)
+        "hot_dtype": ps["hot_dtype"],
+        "promotions_total": ps["promotions_total"],
+        "shared_nnz_frac": (round(ps["shared_nnz_frac"], 6)
+                            if ps["shared_nnz_frac"] is not None
+                            else None),
+        "kernel_dtype": stats["dtype"],
     })
     extra.update(_telemetry_extras(ph))
     if fallback_sized:

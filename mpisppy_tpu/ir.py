@@ -233,9 +233,111 @@ class SplitA:
                              (S,) + tuple(self.shared.shape))
         return A.at[:, self.rows, self.cols].add(self.vals)
 
+    def astype(self, dt):
+        """Cast shared + per-scenario values (the mixed-precision hot
+        loop's storage cast, ops/pdhg hot_dtype); the int coordinate
+        arrays are untouched.  Subclass-preserving."""
+        return dataclasses.replace(
+            self, shared=self.shared.astype(dt),
+            vals=self.vals.astype(dt))
+
+    def scale_shared(self, row_mult, col_mult):
+        """shared <- diag(row_mult) @ shared @ diag(col_mult), in
+        whatever representation `shared` uses (dense here; coordinate
+        data in SparseSplitA)."""
+        return self.shared * row_mult[:, None] * col_mult[None, :]
+
 
 _register(SplitA, data_fields=("shared", "rows", "cols", "vals"),
           meta_fields=())
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseSplitA(SplitA):
+    """SplitA whose SHARED block is a `jax.experimental.sparse.BCOO`
+    matrix instead of a dense (M, N) array.
+
+    When the shared block itself is sparse (UC/network families: each
+    row touches a handful of variables), the dense (S, N) x (N, M)
+    matmul of the SplitA fast path still pays M*N FLOPs per scenario
+    for mostly-zero entries.  Storing the shared block as BCOO routes
+    `bmatvec`/`bmatvec_t` through the sparse dot_general rules, so the
+    per-iteration cost drops from O(S*M*N) to O(S*nnz(shared) +
+    S*nnz(delta)).  The per-scenario delta stays in (rows, cols, vals)
+    scatter form, identical to SplitA — gather/compaction
+    (`ops/pdhg._gather_prep`, `solve_compacted`) and scenario padding
+    touch only `vals` and work unchanged.
+
+    Built by `sparsify_split` when the shared density is below the
+    solver's `sparse_threshold` knob (dense fallback above it, and
+    whenever jax.experimental.sparse is unavailable)."""
+
+    def to_dense(self):
+        S = self.vals.shape[0]
+        sh = self.shared.todense()
+        A = jnp.broadcast_to(sh[None], (S,) + tuple(sh.shape))
+        return A.at[:, self.rows, self.cols].add(self.vals)
+
+    def astype(self, dt):
+        from jax.experimental import sparse as jsparse
+        sh = jsparse.BCOO((self.shared.data.astype(dt),
+                           self.shared.indices),
+                          shape=self.shared.shape)
+        return dataclasses.replace(self, shared=sh,
+                                   vals=self.vals.astype(dt))
+
+    def scale_shared(self, row_mult, col_mult):
+        from jax.experimental import sparse as jsparse
+        i = self.shared.indices
+        data = self.shared.data * row_mult[i[:, 0]] * col_mult[i[:, 1]]
+        return jsparse.BCOO((data, i), shape=self.shared.shape)
+
+    @property
+    def shared_nnz_frac(self):
+        """Stored-element fraction of the shared block (the density the
+        sparse_threshold knob gates on; bench JSON `shared_nnz_frac`)."""
+        M, N = self.shared.shape
+        return float(self.shared.nse) / float(max(M * N, 1))
+
+
+_register(SparseSplitA, data_fields=("shared", "rows", "cols", "vals"),
+          meta_fields=())
+
+
+def shared_density(A):
+    """Nonzero fraction of a SplitA's shared block (1.0 for non-split
+    operators — dense batched A never routes sparse)."""
+    if isinstance(A, SparseSplitA):
+        return A.shared_nnz_frac
+    if not isinstance(A, SplitA):
+        return 1.0
+    sh = np.asarray(A.shared)
+    return float(np.count_nonzero(sh)) / float(max(sh.size, 1))
+
+
+def sparsify_split(A, threshold):
+    """Convert a dense-shared SplitA to a SparseSplitA when its shared
+    block's density is below `threshold` (host-side, once per prep —
+    never inside a trace).  Returns `A` unchanged when the threshold is
+    off (<= 0), the density is at/above it, `A` is not a SplitA, or
+    jax.experimental.sparse is unavailable (the dense fallback the
+    mixed-precision docs promise)."""
+    if threshold is None or float(threshold) <= 0.0:
+        return A
+    if not isinstance(A, SplitA) or isinstance(A, SparseSplitA):
+        return A
+    dens = shared_density(A)
+    if dens >= float(threshold):
+        return A
+    try:
+        from jax.experimental import sparse as jsparse
+    except ImportError:        # pragma: no cover - jax always has it
+        return A
+    sh = np.asarray(A.shared)
+    nse = max(int(np.count_nonzero(sh)), 1)
+    bcoo = jsparse.BCOO.fromdense(jnp.asarray(A.shared), nse=nse)
+    return SparseSplitA(shared=bcoo, rows=A.rows, cols=A.cols,
+                        vals=A.vals)
 
 
 class Static:
@@ -284,7 +386,11 @@ def bmatvec(A, x):
     two-stage demand models): one (M, N) matrix turns the batched
     matvec into a real (S, N) x (N, M) matmul on the MXU and cuts the
     constraint-tensor memory by S.  SplitA extends the same fast path
-    to sparse MATRIX uncertainty (shared matmul + nnz scatter)."""
+    to sparse MATRIX uncertainty (shared matmul + nnz scatter).  With
+    a SparseSplitA the shared product routes through
+    jax.experimental.sparse's dot_general rules (the `@` below
+    dispatches on the BCOO type), dropping the dense M*N FLOPs per
+    scenario to nnz(shared)."""
     if isinstance(A, SplitA):
         out = x @ A.shared.T
         return out.at[:, A.rows].add(A.vals * jnp.take(x, A.cols, axis=1))
@@ -437,10 +543,10 @@ def pad_scenarios(batch: ScenarioBatch, to: int) -> ScenarioBatch:
     if isinstance(batch.A, SplitA):
         # a zero-padded scenario gets the SHARED matrix plus ZERO
         # deltas — harmless under the free row bounds + prob 0 below
-        # (same argument as the shared-A case)
-        A_pad = SplitA(
-            shared=batch.A.shared, rows=batch.A.rows, cols=batch.A.cols,
-            vals=padfield(batch.A.vals))
+        # (same argument as the shared-A case); dataclasses.replace
+        # keeps a SparseSplitA sparse
+        A_pad = dataclasses.replace(batch.A,
+                                    vals=padfield(batch.A.vals))
     else:
         A_pad = batch.A if batch.shared_A else padfield(batch.A)
     return ScenarioBatch(

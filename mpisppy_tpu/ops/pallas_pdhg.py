@@ -34,7 +34,11 @@ except ImportError:                                   # pragma: no cover
 def _chunk_kernel(n_steps, A_ref, cs_ref, qs_ref, lb_ref, ub_ref,
                   rlo_ref, rhi_ref, x_ref, y_ref, tau_ref, sig_ref,
                   xo_ref, yo_ref, xs_ref, ys_ref):
-    A = A_ref[:]
+    # mixed-precision slabs (hot_dtype="bf16x") STORE A in bf16 — half
+    # the VMEM per tile — but all arithmetic runs in the state dtype
+    # (f32): the cast up happens once per chunk on the VMEM-resident
+    # tile, so accumulation never drops below the compute precision
+    A = A_ref[:].astype(cs_ref.dtype)
     cs = cs_ref[:]
     qs = qs_ref[:]
     lb = lb_ref[:]

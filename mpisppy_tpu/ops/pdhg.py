@@ -57,6 +57,30 @@ from ..ir import SplitA
 from ..ir import bmatvec as _Ax
 from ..ir import bmatvec_t as _ATy
 
+# hot_dtype knob -> (storage dtype, compute dtype) for the inner loop.
+#   f32:   everything in float32 — the MPAX/PDLP trade: the hot loop
+#          runs ~2x+ faster (CPU SIMD width / MXU rate / HBM traffic)
+#          while the certified bound paths stay f64;
+#   bf16x: A stored in bfloat16 (halves the constraint tensor's HBM
+#          traffic — the dominant bandwidth term), iterates and
+#          accumulation in float32 (bf16 @ f32 dot_generals accumulate
+#          in f32).
+# The knob NEVER upcasts: an f32 batch under hot_dtype="f32" is a
+# no-op, and the final KKT verdict + unscaled SolveResult are always
+# produced in the CALLER's dtype (see _solve_impl).
+HOT_DTYPES = {
+    "f32": ("float32", "float32"),
+    "bf16x": ("bfloat16", "float32"),
+}
+
+
+def eps_floor(dtype):
+    """Tightest tolerance `dtype` arithmetic can express: below
+    ~100 ulp the KKT residuals are rounding noise and the loop would
+    spin to max_iters (the clamp `_solve_impl` has always applied,
+    exposed for the promotion rule)."""
+    return 100.0 * float(jnp.finfo(jnp.dtype(dtype)).eps)
+
 
 def _register(cls, data_fields, meta_fields=()):
     jax.tree_util.register_dataclass(
@@ -281,8 +305,8 @@ def _gather_prep(prep: PreparedBatch, ii) -> PreparedBatch:
 
     A = prep.A
     if isinstance(A, SplitA):
-        A = SplitA(shared=A.shared, rows=A.rows, cols=A.cols,
-                   vals=A.vals[ii])
+        # replace (not the constructor) so a SparseSplitA stays sparse
+        A = dataclasses.replace(A, vals=A.vals[ii])
     else:
         A = take(A)
     return PreparedBatch(
@@ -293,13 +317,20 @@ def _gather_prep(prep: PreparedBatch, ii) -> PreparedBatch:
 
 def _unscale_A(A, dr, dc):
     """User-space view of a scaled constraint operator: A / dr / dc,
-    dispatching on representation (dense batched / shared / SplitA)."""
+    dispatching on representation (dense batched / shared / SplitA /
+    SparseSplitA — scale_shared keeps BCOO data in coordinate form)."""
     if isinstance(A, SplitA):
-        return SplitA(
-            shared=A.shared / dr[0][:, None] / dc[0][None, :],
-            rows=A.rows, cols=A.cols,
+        return dataclasses.replace(
+            A,
+            shared=A.scale_shared(1.0 / dr[0], 1.0 / dc[0]),
             vals=A.vals / (dr[:, A.rows] * dc[:, A.cols]))
     return A / dr[:, :, None] / dc[:, None, :]
+
+
+def _cast_A(A, dt):
+    """Storage-dtype cast of a constraint operator (SplitA.astype is
+    subclass-preserving; dense arrays cast directly)."""
+    return A.astype(dt)
 
 
 # --------------------------------------------------------------------------
@@ -447,7 +478,8 @@ class PDHGSolver:
                  restart_every=16, omega0=1.0, use_pallas="auto",
                  pallas_tile=8, pallas_interpret=False,
                  restart_mode="adaptive", restart_beta_sufficient=0.2,
-                 restart_beta_necessary=0.8, compact_threshold=0.0):
+                 restart_beta_necessary=0.8, compact_threshold=0.0,
+                 hot_dtype=None, sparse_threshold=0.0):
         # restart_every is in units of `check_every` inner iterations.
         # Under restart_mode="adaptive" it is the FORCED cycle-length
         # cap (a restart fires at the latest every restart_every
@@ -489,6 +521,18 @@ class PDHGSolver:
         self.use_pallas = bool(use_pallas)
         self.pallas_tile = int(pallas_tile)
         self.pallas_interpret = bool(pallas_interpret)
+        # mixed-precision hot loop (see HOT_DTYPES): None/f64/off keep
+        # the historical behavior — the loop runs in the caller's dtype
+        if hot_dtype in (None, "", "none", "off", "f64", "float64"):
+            hot_dtype = None
+        elif hot_dtype not in HOT_DTYPES:
+            raise ValueError(
+                f"hot_dtype must be one of {sorted(HOT_DTYPES)} (or "
+                f"None/'f64' for full precision), got {hot_dtype!r}")
+        self.hot_dtype = hot_dtype
+        # shared-block density below which a SplitA prep is stored /
+        # multiplied as BCOO (ir.SparseSplitA); 0.0 = always dense
+        self.sparse_threshold = float(sparse_threshold)
 
     @property
     def _solve_jit(self):
@@ -530,7 +574,9 @@ class PDHGSolver:
                 o.get("pdhg_restart_beta_sufficient", 0.2)),
             restart_beta_necessary=float(
                 o.get("pdhg_restart_beta_necessary", 0.8)),
-            compact_threshold=float(o.get("pdhg_compact_threshold", 0.0)))
+            compact_threshold=float(o.get("pdhg_compact_threshold", 0.0)),
+            hot_dtype=o.get("pdhg_hot_dtype"),
+            sparse_threshold=float(o.get("pdhg_sparse_threshold", 0.0)))
 
     def config_key(self):
         """Hashable construction-time config.  `_solve_impl` reads ONLY
@@ -544,7 +590,8 @@ class PDHGSolver:
                 self.restart_every, self.omega0, self.use_pallas,
                 self.pallas_tile, self.pallas_interpret,
                 self.restart_mode, self.restart_beta_sufficient,
-                self.restart_beta_necessary, self.compact_threshold)
+                self.restart_beta_necessary, self.compact_threshold,
+                self.hot_dtype, self.sparse_threshold)
 
     def clone(self, **overrides):
         """A new solver with this one's full config, selected fields
@@ -561,9 +608,49 @@ class PDHGSolver:
             restart_mode=self.restart_mode,
             restart_beta_sufficient=self.restart_beta_sufficient,
             restart_beta_necessary=self.restart_beta_necessary,
-            compact_threshold=self.compact_threshold)
+            compact_threshold=self.compact_threshold,
+            hot_dtype=self.hot_dtype,
+            sparse_threshold=self.sparse_threshold)
         cfg.update(overrides)
         return type(self)(**cfg)
+
+    # -- mixed precision ---------------------------------------------------
+    def hot_eps_floor(self):
+        """Tolerance floor of the configured hot dtype's COMPUTE
+        precision (0.0 when the hot loop runs full precision — nothing
+        to promote from)."""
+        if self.hot_dtype is None:
+            return 0.0
+        return eps_floor(HOT_DTYPES[self.hot_dtype][1])
+
+    def wants_promotion(self, eps=None):
+        """True when a solve at tolerance `eps` (default: the
+        construction-time eps) needs MORE precision than the hot dtype
+        can express — the eps-ladder/KKT promotion rule: drivers
+        (spopt.solve_loop, phbase supersteps) switch to the
+        full-precision solver + prep instead of letting the hot loop
+        clamp eps to its floor and certify at a looser tolerance than
+        requested.  Monotone under the PH eps ladder: the ladder only
+        tightens, so promotion never reverts within a run."""
+        if self.hot_dtype is None:
+            return False
+        e = self.eps if eps is None else float(eps)
+        return e < self.hot_eps_floor()
+
+    def _hot_pair(self, caller_dtype):
+        """(storage, compute) jnp dtypes for the hot loop given the
+        caller's array dtype, or None when no downcast applies (knob
+        off, caller already at/below the hot precision)."""
+        if self.hot_dtype is None:
+            return None
+        store, compute = (jnp.dtype(s)
+                          for s in HOT_DTYPES[self.hot_dtype])
+        dt = jnp.dtype(caller_dtype)
+        if dt == store and dt == compute:
+            return None
+        if jnp.finfo(dt).bits < jnp.finfo(compute).bits:
+            return None     # never upcast the caller's data
+        return store, compute
 
     # -- public ----------------------------------------------------------
     def solve(self, prep: PreparedBatch, c, qdiag, lb, ub,
@@ -713,7 +800,8 @@ class PDHGSolver:
     def _solve_impl(self, prep, c, qdiag, lb, ub, obj_const, x0, y0,
                     consensus=None, eps=None, iters_cap=None):
         dc, dr = prep.d_col, prep.d_row
-        # scale into solver space
+        # scale into solver space (in the caller's precision — the
+        # promotion rules of c * dc fix the OUTPUT dtype below)
         cs = c * dc
         qs = qdiag * dc * dc
         lbs = jnp.where(jnp.isfinite(lb), lb / dc, lb)
@@ -722,15 +810,37 @@ class PDHGSolver:
                        lbs, ubs)
         ys0 = y0 / dr
         A, rlo, rhi = prep.A, prep.row_lo, prep.row_hi
+        # mixed precision (hot_dtype): the while_loop below runs in the
+        # hot COMPUTE dtype with A held in the hot STORAGE dtype; the
+        # final KKT verdict and the returned SolveResult are produced
+        # back in the caller's dtype (out_dt), so warm starts, PH state
+        # and checkpoints never silently narrow.  The *_f views feed
+        # that final verdict — aliases when no downcast applies.
+        out_dt = cs.dtype
+        hot = self._hot_pair(out_dt)
+        cs_f, qs_f, lbs_f, ubs_f = cs, qs, lbs, ubs
+        A_f, rlo_f, rhi_f = A, rlo, rhi
+        if hot is not None:
+            store, compute = hot
+            cs, qs = cs.astype(compute), qs.astype(compute)
+            lbs, ubs = lbs.astype(compute), ubs.astype(compute)
+            xs0, ys0 = xs0.astype(compute), ys0.astype(compute)
+            rlo, rhi = rlo.astype(compute), rhi.astype(compute)
+            A = _cast_A(A, store)
         S, N = cs.shape
-        # clamp the tolerance to what the dtype can express: in float32
-        # an eps below ~1e-5 can never be met and every solve would spin
-        # to max_iters
+        # clamp the tolerance to what the LOOP dtype can express: in
+        # float32 an eps below ~1e-5 can never be met and every solve
+        # would spin to max_iters.  (Callers needing a tighter eps than
+        # the hot floor promote to full precision instead —
+        # wants_promotion.)  The final verdict reuses the same clamped
+        # value in the caller's dtype (eps_out).
         floor = 100.0 * float(jnp.finfo(cs.dtype).eps)
         if eps is None:
             eps = max(self.eps, floor)
+            eps_out = eps
         else:
-            eps = jnp.maximum(jnp.asarray(eps, cs.dtype), floor)
+            eps_out = jnp.maximum(jnp.asarray(eps, out_dt), floor)
+            eps = eps_out.astype(cs.dtype)
 
         if consensus is not None:
             from ..ir import node_segment_sum
@@ -790,7 +900,9 @@ class PDHGSolver:
             xs0 = jnp.clip(cavg(xs0), lbs, ubs)  # consistent warm start
         else:
             csum = cavg = None
-            anorm = prep.anorm
+            # cast, not recompute: the norm estimate from the (possibly
+            # low-precision) prep is accurate far beyond step-size needs
+            anorm = prep.anorm.astype(cs.dtype)
             qmax = jnp.max(qs, axis=1)
 
         def steps(x, y, omega, n):
@@ -825,9 +937,14 @@ class PDHGSolver:
             x, y, xs, ys = lax.fori_loop(0, n, body, (x, y, zx, zy))
             return x, y, xs, ys
 
-        def kkt_score(x, y):
+        def kkt_score(x, y, data=None):
+            # data: optional (cs, qs, A, rlo, rhi, lbs, ubs) override —
+            # the final verdict passes the caller-precision views
+            if data is None:
+                data = (cs, qs, A, rlo, rhi, lbs, ubs)
+            csk, qsk, Ak, rlok, rhik, lbsk, ubsk = data
             pres, dres, gap, pobj, dobj = _residuals(
-                x, y, cs, qs, A, rlo, rhi, lbs, ubs, cavg=cavg)
+                x, y, csk, qsk, Ak, rlok, rhik, lbsk, ubsk, cavg=cavg)
             if consensus is not None:
                 # each EF COPY is one problem: its scenarios share one
                 # verdict, and only the SUMS of its per-scenario
@@ -984,7 +1101,16 @@ class PDHGSolver:
 
         x = jnp.where(fin.converged[:, None], fin.x_best, fin.x)
         y = jnp.where(fin.converged[:, None], fin.y_best, fin.y)
-        _, pres, dres, gap = kkt_score(x, y)
+        if hot is not None:
+            # promote the final iterate to the caller's dtype and
+            # recheck the verdict there: frozen scenarios keep their
+            # hot-precision certificate (the semantics a native-f32
+            # run has always had) and the full-precision recheck can
+            # only ADD conversions
+            x = x.astype(out_dt)
+            y = y.astype(out_dt)
+        _, pres, dres, gap = kkt_score(
+            x, y, data=(cs_f, qs_f, A_f, rlo_f, rhi_f, lbs_f, ubs_f))
         # unscale
         xu = x * dc
         yu = y * dr
@@ -1002,6 +1128,7 @@ class PDHGSolver:
         return SolveResult(
             x=xu, y=yu, obj=pobj, dual_obj=dobj + obj_const,
             pres=pres, dres=dres, gap=gap,
-            converged=fin.converged | ((pres < eps) & (dres < eps)
-                                       & (gap < eps)),
+            converged=fin.converged | ((pres < eps_out)
+                                       & (dres < eps_out)
+                                       & (gap < eps_out)),
             iters=fin.k * ne, restarts=fin.restarts)

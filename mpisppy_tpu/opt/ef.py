@@ -113,9 +113,12 @@ class ExtensiveForm(SPOpt):
                    else (lambda a: jnp.asarray(np.asarray(a, np.float64))))
             prep64 = prepare_batch(put(b.A), put(b.row_lo), put(b.row_hi),
                                    shared_cols=True)
+            # hot_dtype pinned OFF: this is the certified f64 authority
+            # for the coupled EF solve (AST-guarded in
+            # tests/test_precision.py)
             s64 = self.solver.clone(
                 max_iters=max(self.solver.max_iters, 100000),
-                use_pallas=False)
+                use_pallas=False, hot_dtype=None)
             r64 = s64.solve(
                 prep64,
                 put(c),

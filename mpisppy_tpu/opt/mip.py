@@ -127,8 +127,12 @@ class ExtensiveFormMIP(ExtensiveForm):
         s = self._np_cache.get(key)
         if s is None:
             # clone: every knob (restart policy, betas, pallas config)
-            # stays in lockstep with the certified solver's config
-            s = self.solver.clone(max_iters=max_iters)
+            # stays in lockstep with the certified solver's config —
+            # except hot_dtype, pinned OFF: dive probes feed bound
+            # decisions (prune/accept), which must never rest on a
+            # low-precision verdict (AST-guarded in
+            # tests/test_precision.py)
+            s = self.solver.clone(max_iters=max_iters, hot_dtype=None)
             self._np_cache[key] = s
         return s
 

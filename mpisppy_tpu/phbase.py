@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import global_toc
-from .ir import ScenarioBatch, node_segment_sum
+from .ir import ScenarioBatch, SparseSplitA, node_segment_sum
 from .resilience.chaos import ChaosInjector
 from .spopt import SPOpt
 from .utils import mfu as _mfu
@@ -56,6 +56,10 @@ class PHState:
     solve_iters: Any = 0  # () int kernel iterations of the last solve
     active_frac: Any = 1.0  # () unconverged fraction (prob>0) last solve
     solve_restarts: Any = 0  # () int restart events of the last solve
+    # () int 1 when the last solve ran on the promoted full-precision
+    # pair (hot_dtype runs only; stays 0 otherwise) — checkpointed so a
+    # resumed run knows its precision history (resilience/checkpoint.py)
+    promoted: Any = 0
 
 
 _register(PHState, tuple(f.name for f in dataclasses.fields(PHState)))
@@ -452,22 +456,39 @@ class PHBase(SPOpt):
         """Advance self.state by one superstep and sync.  Telemetry
         phase timing (when ON) routes through the unfused per-phase
         path; otherwise this is byte-for-byte the pre-telemetry fused
-        call — the zero-cost-when-off contract of telemetry/."""
+        call — the zero-cost-when-off contract of telemetry/.
+
+        A hot-dtype run promotes here too: once the superstep tolerance
+        (ladder or static) crosses the hot dtype's eps floor, the
+        promoted full-precision (solver, prep) pair takes over —
+        monotone under the ladder, so at most one extra superstep
+        compile per run."""
+        solver, prep = self.active_solver_prep(self.superstep_eps)
         if self._tel.phase_timing:
-            self._superstep_phased()
+            self._superstep_phased(solver, prep)
         else:
-            self.state = self._superstep(
+            self.state = fused_superstep(solver)(
                 self.state, self.rho, self.W_on, self.prox_on,
-                self.lb_eff, self.ub_eff, self.superstep_eps, self.prep,
+                self.lb_eff, self.ub_eff, self.superstep_eps, prep,
                 self.batch)
             jax.block_until_ready(self.state.x)
+        if solver is not self.solver:
+            self.state = dataclasses.replace(
+                self.state, promoted=jnp.asarray(1, jnp.int32))
 
-    def _phase_impls(self):
+    def _phase_impls(self, solver=None):
         """Jitted per-phase cuts of _superstep_impl (solve / xbar-psum
         / W-update / conv), functionally identical to the fused body —
         only the phase boundaries differ, so the phase-timed iteration
-        produces the same PHState."""
-        fns = self._phase_jits
+        produces the same PHState.  `solver` defaults to the configured
+        one; the promoted full-precision solver gets its own cache
+        entry (config_key differs)."""
+        solver = self.solver if solver is None else solver
+        key = solver.config_key()
+        cache = self._phase_jits
+        if cache is None:
+            cache = self._phase_jits = {}
+        fns = cache.get(key)
         if fns is not None:
             return fns
 
@@ -475,7 +496,7 @@ class PHBase(SPOpt):
             c_eff, q_eff = ph_objective_arrays(
                 batch, state.W, rho, state.xbar,
                 W_on=W_on, prox_on=prox_on)
-            return self.solver._solve_jit(
+            return solver._solve_jit(
                 prep, c_eff, q_eff, lb, ub, batch.obj_const,
                 state.x, state.y, None, eps)
 
@@ -492,10 +513,10 @@ class PHBase(SPOpt):
 
         fns = {"solve": jax.jit(solve), "xbar": jax.jit(xbar),
                "w_update": jax.jit(w_up), "conv": jax.jit(conv)}
-        self._phase_jits = fns
+        cache[key] = fns
         return fns
 
-    def _superstep_phased(self):
+    def _superstep_phased(self, solver=None, prep=None):
         """One PH iteration with per-phase spans + timing histograms
         (ph.phase.{solve,psum,w_update,conv}_seconds).  Each phase runs
         as its own jitted call with a device sync between phases — the
@@ -503,12 +524,13 @@ class PHBase(SPOpt):
         is why this path exists ONLY behind tel.phase_timing."""
         tel = self._tel
         st, b = self.state, self.batch
-        fns = self._phase_impls()
+        prep = self.prep if prep is None else prep
+        fns = self._phase_impls(solver)
         t0 = time.monotonic()
         with tel.span("ph.phase.solve"):
             res = fns["solve"](st, self.rho, self.W_on, self.prox_on,
                                self.lb_eff, self.ub_eff,
-                               self.superstep_eps, self.prep, b)
+                               self.superstep_eps, prep, b)
             jax.block_until_ready(res.x)
         t1 = time.monotonic()
         with tel.span("ph.phase.psum"):
@@ -552,9 +574,12 @@ class PHBase(SPOpt):
         rst_n = int(self.state.solve_restarts)
         self._flops += _mfu.pdhg_flops(
             it_n, b.num_scens, b.num_rows,
-            b.num_vars, self.solver.check_every)
+            b.num_vars, self.solver.check_every,
+            density=self._prep_density(self.prep))
         self._kernel_iters += it_n
         self._restarts_total += rst_n
+        if isinstance(self.prep.A, SparseSplitA):
+            self._sparse_matvecs += 2 * it_n
         self._active_fraction = float(self.state.active_frac)
         wall = time.time() - t0
         self._solve_wall += wall
@@ -574,6 +599,8 @@ class PHBase(SPOpt):
             r.counter("pdhg.inner_iters_total").inc(it_n)
             r.counter("pdhg.restarts_total").inc(rst_n)
             r.gauge("pdhg.active_fraction").set(self._active_fraction)
+            if isinstance(self.prep.A, SparseSplitA):
+                r.counter("pdhg.sparse_matvecs").inc(2 * it_n)
             if self._ladder is not None:
                 r.gauge("ph.superstep_eps").set(self._ladder_eps)
         return self.conv

@@ -3,7 +3,7 @@
 Extends the WXBarWriter W/xbar snapshot (`utils/wxbarutils.py`) into a
 complete PH run checkpoint: the whole `PHState` (x, y, W, xbar,
 xsqbar, obj, dual_obj, conv, it, solve_iters, active_frac,
-solve_restarts) plus the run-level
+solve_restarts, promoted) plus the run-level
 scalars (trivial/best bound) and — when the optimizer runs under a
 hub — the hub's BestInnerBound/BestOuterBound and incumbent nonant
 solution.  Restoring the full state makes the resumed trajectory
@@ -60,6 +60,14 @@ def save_run_checkpoint(path, opt):
         "solve_iters": np.int64(st.solve_iters),
         "active_frac": np.float64(st.active_frac),
         "solve_restarts": np.int64(np.asarray(st.solve_restarts)),
+        # precision state (PR 6): whether the last solve ran on the
+        # promoted full-precision pair, and the ladder's current
+        # tolerance — a resumed hot-dtype run must not silently fall
+        # back to the loose start-of-ladder precision
+        "promoted": np.int64(np.asarray(st.promoted)),
+        "ladder_eps": _opt_float(getattr(opt, "_ladder_eps", None)
+                                 if getattr(opt, "_ladder", None)
+                                 is not None else None),
         "trivial_bound": _opt_float(getattr(opt, "trivial_bound", None)),
         "best_bound": _opt_float(getattr(opt, "best_bound", None)),
         "nonant_names": (
@@ -122,10 +130,20 @@ def load_run_checkpoint(path, opt):
             float(z["active_frac"]) if "active_frac" in z else 1.0, dt),
         solve_restarts=jnp.asarray(
             int(z["solve_restarts"]) if "solve_restarts" in z else 0,
-            jnp.int32))
+            jnp.int32),
+        # pre-PR-6 checkpoints carry no precision fields: they were
+        # written by full-precision (f64-era) runs, so promoted=0
+        promoted=jnp.asarray(
+            int(z["promoted"]) if "promoted" in z else 0, jnp.int32))
     opt.conv = float(z["conv"])
     opt.trivial_bound = _opt_load(z["trivial_bound"])
     opt.best_bound = _opt_load(z["best_bound"])
+    if "ladder_eps" in z and getattr(opt, "_ladder", None) is not None:
+        lad_eps = _opt_load(z["ladder_eps"])
+        if lad_eps is not None:
+            # monotone: the restored tolerance can only tighten the
+            # freshly-initialized ladder, never loosen it
+            opt._ladder_eps = min(opt._ladder_eps, lad_eps)
     return z
 
 

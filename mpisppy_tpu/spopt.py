@@ -23,7 +23,8 @@ import numpy as np
 
 from . import global_toc
 from . import telemetry as _telemetry
-from .ir import SplitA, bmatvec, delta_idx
+from .ir import (SparseSplitA, SplitA, bmatvec, delta_idx,
+                 shared_density, sparsify_split)
 from .ops.pdhg import (PDHGSolver, PreparedBatch, prepare_batch,
                        prepare_batch_split, prepare_split_native)
 from .spbase import SPBase
@@ -49,27 +50,13 @@ class SPOpt(SPBase):
             self.prep = prep
         else:
             global_toc("Preparing batch (Ruiz scaling + ||A|| estimate)")
-            delta = delta_idx(self.batch)
-            if self.batch.split_A:
-                # batch born split-native (no dense A exists, true-size
-                # instances): the split prep is the ONLY prep
-                self.prep = prepare_split_native(
-                    self.batch.A, self.batch.row_lo, self.batch.row_hi)
-            elif (delta is not None and self._use_split_prep
-                    and not self.batch.shared_A
-                    and not o.get("no_split_prep")):
-                # sparse matrix uncertainty (ir.SplitA): shared-scaling
-                # Ruiz keeps the shared+delta structure, and shared
-                # columns satisfy _shared_cols implicitly
-                self.prep = prepare_batch_split(
-                    self.batch.A,
-                    jnp.asarray(delta[0], jnp.int32),
-                    jnp.asarray(delta[1], jnp.int32),
-                    self.batch.row_lo, self.batch.row_hi)
-            else:
-                self.prep = prepare_batch(
-                    self.batch.A, self.batch.row_lo, self.batch.row_hi,
-                    shared_cols=self._shared_cols)
+            self.prep = self._build_prep(hot=self.solver.hot_dtype)
+        # density of the shared constraint block actually carried by the
+        # prep (None for non-split preps) — bench reports it, and the
+        # FLOP accounting debits sparse matvecs by it
+        self._shared_nnz_frac = (float(shared_density(self.prep.A))
+                                 if isinstance(self.prep.A, SplitA)
+                                 else None)
         # warm-start caches (analog of persistent-solver state,
         # reference spopt.py:877 set_instance_retry — license logic gone)
         self._x_warm = None
@@ -84,6 +71,8 @@ class SPOpt(SPBase):
         self._flops_saved = 0.0    # est. FLOPs avoided by compaction
         self._active_traj = []     # last compacted solve's trajectory
         self._active_fraction = 1.0  # last solve's final active fraction
+        self._promotions = 0       # solves promoted hot-dtype -> full
+        self._sparse_matvecs = 0   # matvecs routed through BCOO
         # telemetry (telemetry/): the options value configures the
         # process-global handle; every instrument lookup below is a
         # null no-op when disabled (zero-cost-when-off contract)
@@ -93,7 +82,93 @@ class SPOpt(SPBase):
         self.solver_eps = jnp.asarray(self.solver.eps, self.batch.c.dtype)
         # f64 fallback solver for certified solves (lazily built)
         self._solver64 = None
+        # full-precision (solver, prep) pair a hot-dtype run promotes
+        # to once the tolerance crosses the dtype's eps floor
+        self._promoted_cache = None
         self._np_cache = {}
+
+    def _build_prep(self, hot=None):
+        """Ruiz scaling + ||A|| estimate over the batch constraint data.
+
+        hot: a HOT_DTYPES key — cast A and the row bounds to that mode's
+        COMPUTE dtype first, so the equilibration and the power-iteration
+        norm estimate themselves run in low precision (the prep is an
+        input to the hot loop only; certified paths build their own f64
+        prep in `_certified_resolve`).  When the solver carries a
+        sparse_threshold, a SplitA prep whose shared block is sparse
+        enough is converted to the BCOO-backed SparseSplitA afterward —
+        Ruiz row/column scaling preserves the zero pattern, so the
+        density measured post-scaling equals the structural density.
+        """
+        b = self.batch
+        o = self.options
+        A, row_lo, row_hi = b.A, b.row_lo, b.row_hi
+        pair = (self.solver._hot_pair(jnp.asarray(b.c).dtype)
+                if hot else None)
+        if pair is not None:
+            compute = pair[1]
+            A = (A.astype(compute) if isinstance(A, SplitA)
+                 else jnp.asarray(A, compute))
+            row_lo = jnp.asarray(row_lo, compute)
+            row_hi = jnp.asarray(row_hi, compute)
+        delta = delta_idx(b)
+        if b.split_A:
+            # batch born split-native (no dense A exists, true-size
+            # instances): the split prep is the ONLY prep
+            prep = prepare_split_native(A, row_lo, row_hi)
+        elif (delta is not None and self._use_split_prep
+                and not b.shared_A and not o.get("no_split_prep")):
+            # sparse matrix uncertainty (ir.SplitA): shared-scaling
+            # Ruiz keeps the shared+delta structure, and shared
+            # columns satisfy _shared_cols implicitly
+            prep = prepare_batch_split(
+                A, jnp.asarray(delta[0], jnp.int32),
+                jnp.asarray(delta[1], jnp.int32), row_lo, row_hi)
+        else:
+            prep = prepare_batch(A, row_lo, row_hi,
+                                 shared_cols=self._shared_cols)
+        if self.solver.sparse_threshold > 0.0 \
+                and isinstance(prep.A, SplitA):
+            spA = sparsify_split(prep.A, self.solver.sparse_threshold)
+            if spA is not prep.A:
+                prep = dataclasses.replace(prep, A=spA)
+        return prep
+
+    def _promoted_pair(self):
+        """The full-precision (solver, prep) pair used once a solve's
+        tolerance crosses the hot dtype's eps floor.  Built lazily (one
+        extra prep + at most one extra jit compile per run — promotion
+        is monotone under the eps ladder) and cached."""
+        if self._promoted_cache is None:
+            self._promoted_cache = (self.solver.clone(hot_dtype=None),
+                                    self._build_prep(hot=None))
+        return self._promoted_cache
+
+    def active_solver_prep(self, eps=None, count=True):
+        """(solver, prep) for a solve at tolerance `eps`: the configured
+        pair until `eps` crosses the hot dtype's floor (100x machine
+        epsilon of the compute dtype), then the promoted full-precision
+        pair.  With no hot_dtype this is always (self.solver, self.prep).
+        count=True increments the promotion accounting when the
+        promoted pair is selected."""
+        e = float(self.solver_eps if eps is None else eps)
+        if not self.solver.wants_promotion(e):
+            return self.solver, self.prep
+        solver, prep = self._promoted_pair()
+        if count:
+            self._promotions += 1
+            if self._tel.enabled:
+                self._tel.registry.counter("pdhg.promotions").inc()
+        return solver, prep
+
+    @staticmethod
+    def _prep_density(prep):
+        """FLOP discount for the matvec model: the BCOO path does
+        ~density x the dense shared-block work; dense preps pay full
+        price."""
+        if isinstance(prep.A, SparseSplitA):
+            return float(prep.A.shared_nnz_frac)
+        return 1.0
 
     # -- hot path ---------------------------------------------------------
     def solve_loop(self, c=None, qdiag=None, lb=None, ub=None,
@@ -136,19 +211,26 @@ class SPOpt(SPBase):
             cache = self._named_warm.get(warm, (None, None))
         else:
             cache = (self._x_warm, self._y_warm) if warm else (None, None)
-        args = (self.prep,
+        eps_arg = self.solver_eps if eps is None else eps
+        # hot-dtype promotion: once the requested tolerance crosses the
+        # low-precision eps floor, route this solve through the
+        # full-precision pair (monotone under the ladder/Gapper
+        # schedules, so this re-routes at most once per run)
+        solver, prep = self.active_solver_prep(eps_arg)
+        dens = self._prep_density(prep)
+        args = (prep,
                 b.c if c is None else c,
                 b.qdiag if qdiag is None else qdiag,
                 b.lb if lb is None else lb,
                 b.ub if ub is None else ub)
         kw = dict(obj_const=b.obj_const, x0=cache[0], y0=cache[1],
-                  eps=self.solver_eps if eps is None else eps)
+                  eps=eps_arg)
         # compaction (opt-in via pdhg_compact_threshold) applies only
         # to uncapped solves: an iters_cap caller is screening and owns
         # its own budget/shape discipline
-        if self.solver.compact_threshold > 0.0 and iters_cap is None:
+        if solver.compact_threshold > 0.0 and iters_cap is None:
             traj = []
-            res = self.solver.solve_compacted(
+            res = solver.solve_compacted(
                 *args, **kw, probs=b.prob, on_segment=traj.append)
             self._active_traj = traj
             full = float(max(int(np.sum(np.asarray(b.prob) > 0)), 1))
@@ -160,11 +242,11 @@ class SPOpt(SPBase):
                 _mfu.pdhg_flops(t["seg_iters"],
                                 b.num_scens - t["width"],
                                 b.num_rows, b.num_vars,
-                                self.solver.check_every)
+                                solver.check_every, density=dens)
                 for t in traj if t["width"] < b.num_scens)
             self._flops_saved += saved
         else:
-            res = self.solver.solve(*args, **kw, iters_cap=iters_cap)
+            res = solver.solve(*args, **kw, iters_cap=iters_cap)
             saved = 0.0
             self._active_fraction = float(
                 np.sum(np.asarray(~res.converged)
@@ -175,7 +257,11 @@ class SPOpt(SPBase):
         # net of compaction savings: saved counts work NOT done
         self._flops += _mfu.pdhg_flops(
             it_n, b.num_scens, b.num_rows, b.num_vars,
-            self.solver.check_every) - saved
+            solver.check_every, density=dens) - saved
+        if isinstance(prep.A, SparseSplitA):
+            # two shared-block products (forward + transpose) per
+            # inner iteration route through jax.experimental.sparse
+            self._sparse_matvecs += 2 * it_n
         self._kernel_iters += it_n
         self._restarts_total += rst_n
         if certify:
@@ -208,6 +294,8 @@ class SPOpt(SPBase):
                 * int(np.sum(np.asarray(b.prob) > 0)))
             if saved:
                 r.counter("pdhg.flops_saved").inc(saved)
+            if isinstance(prep.A, SparseSplitA):
+                r.counter("pdhg.sparse_matvecs").inc(2 * it_n)
             if rst_n:
                 # mean restart cycle length in inner iterations: total
                 # iterate-steps taken across the batch over the number
@@ -295,9 +383,12 @@ class SPOpt(SPBase):
             # clone: keeps the restart policy/betas (and every future
             # knob) in lockstep with the fast solver's config; the f64
             # fallback typically runs on host CPU, where the Pallas
-            # kernel has no business
+            # kernel has no business.  hot_dtype is pinned OFF: the
+            # certified verdict is this path's whole purpose, so it
+            # never inherits a low-precision hot loop (AST-guarded in
+            # tests/test_precision.py).
             self._solver64 = self.solver.clone(
-                max_iters=cert_iters, use_pallas=False)
+                max_iters=cert_iters, use_pallas=False, hot_dtype=None)
         try:
             cpu = jax.devices("cpu")[0]
         except RuntimeError:
@@ -444,37 +535,58 @@ class SPOpt(SPBase):
         self._flops_saved = 0.0
         self._active_traj = []
         self._active_fraction = 1.0
+        self._promotions = 0
+        self._sparse_matvecs = 0
+
+    def _kernel_dtype(self):
+        """dtype the hot-loop matvec FLOPs actually execute in: the hot
+        STORAGE dtype when configured (bf16 for bf16x — that is the
+        multiply datapath), else the batch dtype."""
+        from .ops.pdhg import HOT_DTYPES
+        if self.solver.hot_dtype is not None:
+            return HOT_DTYPES[self.solver.hot_dtype][0]
+        return str(jnp.asarray(self.batch.c).dtype)
 
     def pdhg_stats(self):
         """Adaptive-work counters across all solve_loop calls since the
         last reset: total inner iterations, restart events, estimated
-        FLOPs saved by compaction, the final active fraction, and the
-        last compacted solve's active-fraction trajectory (one entry
-        per segment).  bench.py surfaces these as `inner_iters` /
-        `active_fraction_final` / `active_fraction_traj`."""
+        FLOPs saved by compaction, the final active fraction, the last
+        compacted solve's active-fraction trajectory (one entry per
+        segment), plus the precision/sparsity state: the configured
+        hot dtype, solves promoted to full precision, sparse matvec
+        count and the shared-block density (None when the prep carries
+        no split matrix).  bench.py surfaces these."""
         return {
             "inner_iters": int(self._kernel_iters),
             "restarts_total": int(self._restarts_total),
             "flops_saved": float(self._flops_saved),
             "active_fraction_final": float(self._active_fraction),
             "active_fraction_traj": list(self._active_traj),
+            "hot_dtype": self.solver.hot_dtype,
+            "promotions_total": int(self._promotions),
+            "sparse_matvecs": int(self._sparse_matvecs),
+            "shared_nnz_frac": self._shared_nnz_frac,
         }
 
     def solve_stats(self):
         """Accumulated kernel FLOPs / wall-clock / MFU across all
         solve_loop calls (dtiming analog, extended with hardware
-        utilization — see utils/mfu.py)."""
+        utilization — see utils/mfu.py).  The MFU peak is dtype-aware:
+        a hot-dtype run is measured against the low-precision peak its
+        matvecs actually target."""
         dev = jax.devices()[0]
-        u = _mfu.mfu(self._flops, self._solve_wall, dev)
+        dt = self._kernel_dtype()
+        u = _mfu.mfu(self._flops, self._solve_wall, dev, dtype=dt)
         _mfu.record_to_registry(self._tel.registry, self._flops,
                                 self._solve_wall,
                                 kernel_iters=self._kernel_iters,
-                                device=dev)
+                                device=dev, dtype=dt)
         return {
             "flops": self._flops,
             "solve_wall_s": self._solve_wall,
             "certify_wall_s": self._certify_wall,
             "mfu": u,
+            "dtype": dt,
             "device": getattr(dev, "device_kind", dev.platform),
         }
 

@@ -210,7 +210,8 @@ def pdhg_counters(registry=None):
     the run had telemetry off — keys are stable either way)."""
     reg = registry if registry is not None else get().registry
     names = ("pdhg.inner_iters_total", "pdhg.restarts_total",
-             "pdhg.flops_saved")
+             "pdhg.flops_saved", "pdhg.promotions",
+             "pdhg.sparse_matvecs")
     vals = ({k: c.value for k, c in reg._counters.items()}
             if reg.enabled else {})
     out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}

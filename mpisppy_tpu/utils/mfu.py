@@ -6,10 +6,20 @@ far the superstep runs from chip peak, so the solve engine
 (spopt.SPOpt.solve_loop) accumulates matvec FLOPs here and bench.py
 reports `mfu` and `iters_per_sec`.
 
-Peak numbers are per-chip dense matmul peaks from public TPU specs
-(jax-ml.github.io/scaling-book hardware table).  MXU f32 runs at half
-the bf16 rate on most generations; the kernel iterates in f32, so the
-f32 peak is the honest denominator.
+Peak numbers are dtype-aware:
+
+- TPU: per-chip dense matmul peaks from public specs
+  (jax-ml.github.io/scaling-book hardware table).  MXU f32 runs at
+  half the bf16 rate on most generations; f64 is emulated an order of
+  magnitude below f32 (no native f64 datapath), modeled here as
+  f32_peak / 10 — a rough but non-null denominator.
+- CPU: estimated from the host core count x a nominal frequency x
+  SIMD FLOPs/cycle per dtype (AVX2-class FMA defaults: 32 f32, 16
+  f64 FLOPs per core-cycle; bf16 has no wide CPU datapath and falls
+  back to the f32 rate).  Override with env CPU_PEAK_FLOPS.  The
+  estimate is coarse — its job is making the MFU gauge populate on
+  the CPU-fallback bench rounds instead of reporting null — so treat
+  CPU MFU as a relative signal, not a calibrated one.
 """
 
 from __future__ import annotations
@@ -26,11 +36,44 @@ _PEAKS = {
     "v6e": (918e12, 459e12),
 }
 
+# TPUs emulate f64 in software well below the f32 rate; /10 keeps the
+# denominator honest enough to compare runs without overstating peak
+_F64_SLOWDOWN = 10.0
+
+# SIMD FLOPs per core-cycle for the CPU estimate (AVX2 + 2xFMA class:
+# 2 ports x 8 lanes x 2 flops for f32, half the lanes for f64)
+_CPU_FLOPS_PER_CYCLE = {"float32": 32.0, "float64": 16.0,
+                        "bfloat16": 32.0}
+_CPU_NOMINAL_HZ = 2.5e9
+
+
+def _dtype_name(dtype):
+    s = str(dtype)
+    if "bf16" in s or "bfloat16" in s:
+        return "bfloat16"
+    if "64" in s:
+        return "float64"
+    return "float32"
+
+
+def cpu_peak_flops(dtype="float32"):
+    """Estimated aggregate peak FLOP/s of this host for `dtype`.
+    Override with env CPU_PEAK_FLOPS (total, not per-core)."""
+    env = os.environ.get("CPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    cores = os.cpu_count() or 1
+    per_cycle = _CPU_FLOPS_PER_CYCLE[_dtype_name(dtype)]
+    return cores * _CPU_NOMINAL_HZ * per_cycle
+
 
 def device_peak_flops(device=None, dtype="float32"):
-    """Best-effort peak FLOP/s for `device` (default: jax.devices()[0]).
-    Override with env TPU_PEAK_FLOPS.  Returns None on CPU (MFU
-    denominator undefined there)."""
+    """Best-effort peak FLOP/s for `device` (default: jax.devices()[0])
+    at `dtype`.  Override with env TPU_PEAK_FLOPS (wins on every
+    backend) or CPU_PEAK_FLOPS (hosts).  Never returns None: the CPU
+    path uses the
+    core-count x frequency x SIMD-width estimate above so the MFU
+    gauge populates on every backend."""
     env = os.environ.get("TPU_PEAK_FLOPS")
     if env:
         return float(env)
@@ -38,17 +81,23 @@ def device_peak_flops(device=None, dtype="float32"):
         import jax
         device = jax.devices()[0]
     if device.platform == "cpu":
-        return None
+        return cpu_peak_flops(dtype)
     kind = (getattr(device, "device_kind", "") or "").lower()
-    col = 0 if "bf16" in dtype else 1
+    name = _dtype_name(dtype)
     for key, peaks in _PEAKS.items():
         if key in kind:
-            return peaks[col]
-    # unknown TPU kind: assume v5e-class
-    return _PEAKS["v5e"][col]
+            break
+    else:
+        # unknown TPU kind: assume v5e-class
+        peaks = _PEAKS["v5e"]
+    if name == "bfloat16":
+        return peaks[0]
+    if name == "float64":
+        return peaks[1] / _F64_SLOWDOWN
+    return peaks[1]
 
 
-def pdhg_flops(iters, S, M, N, check_every=40):
+def pdhg_flops(iters, S, M, N, check_every=40, density=1.0):
     """FLOPs of `iters` PDHG iterations over an (S, M, N) batch.
 
     Per inner iteration: two batched matvecs (A^T y and A x~), 2*S*M*N
@@ -56,15 +105,21 @@ def pdhg_flops(iters, S, M, N, check_every=40):
     2*(2*S*M*N)*2... we count 1 FLOP per multiply and per add:
     each matvec = 2*M*N*S FLOP, so 4*S*M*N per iteration, plus the KKT
     check (2 more matvecs) every `check_every` iterations.
+
+    density: nnz fraction of the constraint block when the matvecs run
+    through the BCOO sparse path (ir.SparseSplitA) — sparse products
+    only touch stored entries, so the dense model is debited by it.
+    Dense matvecs pass the default 1.0.
     """
-    per_iter = 4.0 * S * M * N
-    checks = 4.0 * S * M * N / max(check_every, 1)
+    per_iter = 4.0 * S * M * N * density
+    checks = 4.0 * S * M * N * density / max(check_every, 1)
     return float(iters) * (per_iter + checks)
 
 
 def mfu(flops, wall_seconds, device=None, dtype="float32"):
-    """Model FLOP utilization in [0, 1], or None when no peak is known
-    (CPU)."""
+    """Model FLOP utilization in [0, 1], or None when wall time is
+    degenerate.  The peak denominator is dtype-aware (see
+    device_peak_flops) and defined on every backend, CPU included."""
     peak = device_peak_flops(device, dtype)
     if peak is None or wall_seconds <= 0:
         return None

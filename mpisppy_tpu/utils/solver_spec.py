@@ -18,7 +18,8 @@ from __future__ import annotations
 
 KNOBS = ("eps", "max_iters", "check_every", "restart_every",
          "restart_mode", "restart_beta_sufficient",
-         "restart_beta_necessary", "compact_threshold")
+         "restart_beta_necessary", "compact_threshold",
+         "hot_dtype", "sparse_threshold")
 
 
 def option_string_to_dict(ostr):

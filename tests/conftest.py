@@ -43,3 +43,7 @@ def pytest_configure(config):
         "markers", "pdhg: adaptive-work solver tests (KKT-triggered "
         "restarts, compaction, inexactness ladder, trace-safety "
         "guard); these RUN under tier-1's `-m 'not slow'`")
+    config.addinivalue_line(
+        "markers", "precision: mixed-precision hot-loop tests "
+        "(hot_dtype, promotion, sparse matvecs, dtype-aware MFU); "
+        "these RUN under tier-1's `-m 'not slow'`")
