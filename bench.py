@@ -528,6 +528,98 @@ def worker_serve():
     print(json.dumps(out))
 
 
+def worker_farmer_stream():
+    """BENCH_MODEL=farmer_stream: StreamingPH over the streamed farmer
+    universe — default S=1,000,000 scenarios, which NEVER materialize:
+    blocks of BENCH_BLOCK (default 256) scenarios are built on demand
+    from their global indices (models/farmer.scenario_block), double-
+    buffered host->device, and solved as randomized-PH supersteps with
+    the full-S dual weights host-resident (mpisppy_tpu/streaming/).
+    The run stops when the BM/BPL sequential rule certifies a CI on
+    the optimality gap of the consensus candidate (measured by
+    ciutils.gap_estimators on fresh estimator samples) — `value` is
+    the wall-clock to that certificate, -1 if the superstep budget ran
+    out uncertified.  No reference comparator exists (the reference
+    cannot load 1e6 farmer scenarios), so vs_baseline is 0.  The JSON
+    carries the streaming-specific fields: sampled_scenarios (final
+    active sample), blocks_per_superstep, prefetch_wait_seconds (~0
+    when block loads fully overlap solves), ci_gap, and the stream.*
+    telemetry counters."""
+    from mpisppy_tpu.utils.platform import (enable_f64_if_cpu,
+                                            ensure_cpu_backend)
+    ensure_cpu_backend()
+
+    from mpisppy_tpu import telemetry
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.streaming import source_for_module
+    from mpisppy_tpu.streaming.streaming_ph import StreamingPH
+
+    on_tpu = not enable_f64_if_cpu()
+    S = int(os.environ.get("BENCH_SCENS", 1_000_000))
+    mult = int(os.environ.get("BENCH_MULT", 1))
+    block = int(os.environ.get("BENCH_BLOCK", 256))
+    iters = int(os.environ.get("BENCH_STREAM_ITERS", 60))
+    rule = os.environ.get("BENCH_STREAM_RULE", "BM")
+    telemetry.configure(True)
+    src = source_for_module(
+        farmer, S, {"crops_multiplier": mult, "split": True})
+    opts = {
+        "defaultPHrho": 1.0, "PHIterLimit": iters,
+        "solver_eps": 1e-5, "superstep_eps": 1e-4,
+        "pdhg_max_iters": 30000,
+        "stream_block_size": block,
+        "stream_check_every": int(
+            os.environ.get("BENCH_STREAM_CHECK", 5)),
+        "stopping_criterion": rule,
+        # BM stop: continue while G > hprime*s + eps_prime; the
+        # s-relative term does the work at farmer's ~1e5 objective
+        # scale (an absolute eps alone would never fire).  CI upper
+        # is h*s + eps — ~1-2% of the objective at certification.
+        "BM_h": float(os.environ.get("BENCH_BM_H", 2.0)),
+        "BM_hprime": float(os.environ.get("BENCH_BM_HPRIME", 0.35)),
+        "BM_eps": float(os.environ.get("BENCH_BM_EPS", 200.0)),
+        "crops_multiplier": mult,
+        "telemetry": True,
+    }
+    sph = StreamingPH(opts, src, module=farmer)
+    t0 = time.time()
+    conv, eobj, trivial = sph.stream_main()
+    wall = time.time() - t0
+    st = sph.stream_stats()
+    counters = telemetry.stream_counters()
+    stats = sph.solve_stats()
+    certified = sph.certified is not None
+    out = {
+        "metric": f"farmer_stream{S}_ph_seconds_to_certified_ci",
+        "value": round(wall, 3) if certified else -1,
+        "unit": "s", "vs_baseline": 0,
+        "sampled_scenarios": st["sampled_scenarios"],
+        "blocks_per_superstep": round(st["blocks_per_superstep"], 3),
+        "prefetch_wait_seconds": round(st["prefetch_wait_seconds"], 4),
+        "ci_gap": st["ci_gap"],
+        "certified": certified,
+        "stopping_criterion": rule,
+        "supersteps": st["supersteps"],
+        "block_width": st["block_width"],
+        "peak_block_scens": st["peak_block_scens"],
+        "sample_growth_events": st["sample_growth_events"],
+        "blocks_loaded": st["blocks_loaded"],
+        "scenarios_streamed": st["scenarios_streamed"],
+        "eobj": round(float(eobj), 3),
+        "trivial_bound_estimate": round(float(trivial), 3),
+        "conv": round(float(conv), 6),
+        "mfu": (round(stats["mfu"], 6) if stats["mfu"] is not None
+                else None),
+        "kernel_dtype": stats["dtype"],
+        "device": stats["device"], "scens": S,
+        "crops_multiplier": mult,
+        **counters}
+    if not certified:
+        out["note"] = (f"uncertified after {st['supersteps']} "
+                       f"supersteps (rule {rule})")
+    print(json.dumps(out))
+
+
 def worker():
     """The measured run (executes on whatever backend the env gives)."""
     model = os.environ.get("BENCH_MODEL", "farmer")
@@ -537,6 +629,8 @@ def worker():
         return worker_sslp()
     if model == "serve":
         return worker_serve()
+    if model == "farmer_stream":
+        return worker_farmer_stream()
     import numpy as np
 
     from mpisppy_tpu.utils.platform import (enable_f64_if_cpu,

@@ -24,6 +24,13 @@ parameterization:
   n_k solves the quadratic  -eps n + (1 + t s) sqrt(n) + n_{k-1} G = 0
   in sqrt(n).  Final CI: [0, BPL_eps].
 
+The rule arithmetic lives in the standalone `SamplingRule` class so
+consumers with their OWN gap estimate (streaming.AdaptiveSampler feeds
+it G/s from a sampled-PH trajectory) can drive the schedule without
+inheriting SeqSampling's solve loop.  SeqSampling composes a rule and
+mirrors its knobs as instance attributes for back-compat
+(multi_seqsampling and user code read `self.h` / `self.bpl_eps` etc.).
+
 Shared options (reference cfg knobs, same names):
   sample_size_ratio — m_k = ratio * n_k scenarios for the xhat solve
   ArRP              — pool G/s from ArRP disjoint sub-estimators
@@ -63,30 +70,29 @@ def _bm_constant(p, q, confidence_level, r=2):
         ssum / (np.sqrt(2 * np.pi) * (1 - confidence_level))))
 
 
-class SeqSampling:
-    def __init__(self, mname, optionsdict, seed=0,
-                 stochastic_sampling=False,
-                 stopping_criterion="BM", solving_type="EF_2stage"):
-        self.module = (mname if not isinstance(mname, str)
-                       else importlib.import_module(mname))
-        self.options = dict(optionsdict or {})
-        self.seed = int(seed)
-        self.stochastic_sampling = bool(
-            self.options.get("stochastic_sampling", stochastic_sampling))
-        self.stopping_criterion = stopping_criterion
-        self.solving_type = solving_type
+class SamplingRule:
+    """Standalone BM/BPL stopping rule + sample-size schedule.
+
+    Stateless between calls: every method takes the current gap
+    estimate (G, s) and sample size, so any driver that can produce a
+    gap estimate — SeqSampling's sampled-EF loop, the streaming
+    AdaptiveSampler, user code — can ask `should_continue` /
+    `sample_size` without subclassing anything.  Knob names and
+    defaults are exactly SeqSampling's options-dict surface.
+    """
+
+    def __init__(self, options=None, stochastic_sampling=False,
+                 stopping_criterion="BM"):
+        o = dict(options or {})
         if stopping_criterion not in ("BM", "BPL"):
             raise ValueError("Only BM and BPL criteria are supported")
-        o = self.options
+        self.stopping_criterion = stopping_criterion
+        self.stochastic_sampling = bool(
+            o.get("stochastic_sampling", stochastic_sampling))
 
         # shared knobs
         self.confidence = float(o.get("confidence_level", 0.95))
-        self.sample_size_ratio = float(o.get("sample_size_ratio", 1))
-        self.ArRP = int(o.get("ArRP", 1))
-        self.kf_Gs = int(o.get("kf_Gs", 1))
-        self.kf_xhat = int(o.get("kf_xhat", 1))
         self.n0 = int(o.get("n0min", o.get("nn0min", 10)))
-        self.max_iters = int(o.get("max_seq_iters", 200))
 
         # BM knobs [bm2011]
         self.h = float(o.get("BM_h", 2.0))
@@ -106,25 +112,25 @@ class SeqSampling:
         self.growth_function = o.get("growth_function", lambda k: k - 1)
         self.bpl_n0min = int(o.get("BPL_n0min", max(self.n0, 50)))
 
-        if stopping_criterion == "BM":
-            self._c = _bm_constant(self.p, self.q, self.confidence)
+        self._c = (_bm_constant(self.p, self.q, self.confidence)
+                   if stopping_criterion == "BM" else None)
 
     # -- stopping rules (True = CONTINUE, as in the reference) ------------
-    def _bm_continue(self, G, s, nk):
+    def bm_continue(self, G, s, nk):
         return G > self.hprime * s + self.eps_prime
 
-    def _bpl_continue(self, G, s, nk):
+    def bpl_continue(self, G, s, nk):
         t = ciutils.t_quantile(self.confidence, max(nk - 1, 1))
         return (G + t * s / np.sqrt(nk) + 1.0 / np.sqrt(nk)
                 > self.bpl_eps)
 
-    def _continue(self, G, s, nk):
+    def should_continue(self, G, s, nk):
         if self.stopping_criterion == "BM":
-            return self._bm_continue(G, s, nk)
-        return self._bpl_continue(G, s, nk)
+            return self.bm_continue(G, s, nk)
+        return self.bpl_continue(G, s, nk)
 
     # -- sample-size schedules --------------------------------------------
-    def _bm_sampsize(self, k, G, s, nk_m1, r=2):
+    def bm_sampsize(self, k, G, s, nk_m1, r=2):
         if self.q is None:
             lower = ((self._c + 2 * self.p * np.log(k) ** 2)
                      / (self.h - self.hprime) ** 2)
@@ -133,11 +139,11 @@ class SeqSampling:
                      / (self.h - self.hprime) ** 2)
         return int(np.ceil(lower))
 
-    def _bpl_fsp_sampsize(self, k, G, s, nk_m1):
+    def bpl_fsp_sampsize(self, k, G, s, nk_m1):
         return int(np.ceil(self.bpl_c0
                            + self.bpl_c1 * self.growth_function(k)))
 
-    def _stochastic_sampsize(self, k, G, s, nk_m1):
+    def stochastic_sampsize(self, k, G, s, nk_m1):
         """[bpl2012] sec. 5: solve -eps*n + (1+t*s)*sqrt(n) + n_{k-1}G
         = 0 for sqrt(n).  Falls back to the initialization size when no
         (G, s) estimate exists yet (e.g. a multistage iteration whose
@@ -153,17 +159,80 @@ class SeqSampling:
         maxroot = -(np.sqrt(disc) + bq) / (2 * a)
         return int(np.ceil(maxroot ** 2))
 
-    def _sample_size(self, k, G, s, nk_m1):
+    def sample_size(self, k, G, s, nk_m1):
         if self.stochastic_sampling:
-            n = self._stochastic_sampsize(k, G, s, nk_m1)
+            n = self.stochastic_sampsize(k, G, s, nk_m1)
         elif self.stopping_criterion == "BM":
-            n = self._bm_sampsize(k, G, s, nk_m1)
+            n = self.bm_sampsize(k, G, s, nk_m1)
         else:
-            n = self._bpl_fsp_sampsize(k, G, s, nk_m1)
+            n = self.bpl_fsp_sampsize(k, G, s, nk_m1)
         n = max(n, self.n0)
         if nk_m1 is not None:
             n = max(n, nk_m1)      # sample sizes must not shrink
         return n
+
+    # -- the certified interval -------------------------------------------
+    def ci_upper(self, s):
+        """Upper end of the [0, u] gap CI once should_continue says
+        stop: h*s + eps (BM) or the fixed width (BPL)."""
+        if self.stopping_criterion == "BM":
+            return float(self.h * s + self.eps)
+        return float(self.bpl_eps)
+
+
+# Attributes mirrored from the rule onto SeqSampling instances
+# (multi_seqsampling and user code read them there).
+_RULE_ATTRS = ("stochastic_sampling", "confidence", "n0",
+               "h", "hprime", "eps", "eps_prime", "p", "q",
+               "bpl_eps", "bpl_c0", "bpl_c1", "growth_function",
+               "bpl_n0min", "_c")
+
+
+class SeqSampling:
+    def __init__(self, mname, optionsdict, seed=0,
+                 stochastic_sampling=False,
+                 stopping_criterion="BM", solving_type="EF_2stage"):
+        self.module = (mname if not isinstance(mname, str)
+                       else importlib.import_module(mname))
+        self.options = dict(optionsdict or {})
+        self.seed = int(seed)
+        self.stopping_criterion = stopping_criterion
+        self.solving_type = solving_type
+        self.rule = SamplingRule(
+            self.options, stochastic_sampling=stochastic_sampling,
+            stopping_criterion=stopping_criterion)
+        for a in _RULE_ATTRS:
+            setattr(self, a, getattr(self.rule, a))
+        o = self.options
+
+        # loop-only knobs (not part of the rule arithmetic)
+        self.sample_size_ratio = float(o.get("sample_size_ratio", 1))
+        self.ArRP = int(o.get("ArRP", 1))
+        self.kf_Gs = int(o.get("kf_Gs", 1))
+        self.kf_xhat = int(o.get("kf_xhat", 1))
+        self.max_iters = int(o.get("max_seq_iters", 200))
+
+    # -- delegation to the rule (back-compat method names) ----------------
+    def _bm_continue(self, G, s, nk):
+        return self.rule.bm_continue(G, s, nk)
+
+    def _bpl_continue(self, G, s, nk):
+        return self.rule.bpl_continue(G, s, nk)
+
+    def _continue(self, G, s, nk):
+        return self.rule.should_continue(G, s, nk)
+
+    def _bm_sampsize(self, k, G, s, nk_m1, r=2):
+        return self.rule.bm_sampsize(k, G, s, nk_m1, r=r)
+
+    def _bpl_fsp_sampsize(self, k, G, s, nk_m1):
+        return self.rule.bpl_fsp_sampsize(k, G, s, nk_m1)
+
+    def _stochastic_sampsize(self, k, G, s, nk_m1):
+        return self.rule.stochastic_sampsize(k, G, s, nk_m1)
+
+    def _sample_size(self, k, G, s, nk_m1):
+        return self.rule.sample_size(k, G, s, nk_m1)
 
     # -- candidate solve ---------------------------------------------------
     def _candidate(self, n, seed):
@@ -230,10 +299,7 @@ class SeqSampling:
                 stopped = True
                 break
 
-        if self.stopping_criterion == "BM":
-            upper = self.h * s + self.eps
-        else:
-            upper = self.bpl_eps
+        upper = self.rule.ci_upper(s)
         out = {"xhat_one": xhat, "G": G, "std": s, "s": s,
                "num_scens": nk, "T": k, "CI": [0.0, float(upper)],
                "Candidate_solution": xhat,

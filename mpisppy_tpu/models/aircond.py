@@ -208,6 +208,29 @@ def build_batch(branching_factors=(3, 2), start_seed=None,
         tree=tree_info, stage_cost_c=stage_cost_c, var_names=var_names)
 
 
+def scenario_source(num_scens, cfg=None):
+    """streaming.ScenarioSource for aircond.  The scenario universe is
+    one coupled multistage tree — node demands are conditional on the
+    ancestor path, so scenarios cannot be materialized independently
+    from their global index.  Build the tree ONCE (sized by
+    cfg["branching_factors"]; num_scens is ignored, tree-sized like
+    every MULTISTAGE entry point) and serve gathered blocks out of the
+    host-resident batch (streaming.BatchSource).  Note StreamingPH
+    itself rejects multistage consensus; this source exists for the
+    protocol satellite (block materialization, xhat evaluation, EF
+    sub-solves over leaf blocks)."""
+    cfg = dict(cfg or {})
+    from ..utils.config import parse_branching_factors
+    bf = tuple(parse_branching_factors(
+        cfg.get("branching_factors", "3,2")))
+    kw = {k: cfg[k] for k in PARMS if k in cfg}
+    if "start_seed" in cfg:
+        kw["start_seed"] = cfg["start_seed"]
+    batch = build_batch(branching_factors=bf, **kw)
+    from ..streaming import BatchSource
+    return BatchSource(batch, name="aircond")
+
+
 def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
 

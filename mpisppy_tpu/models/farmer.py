@@ -63,13 +63,19 @@ def scenario_yields(scennum, crops_multiplier=1, seedoffset=0):
     return base
 
 
-def build_batch(num_scens, crops_multiplier=1, use_integer=False,
-                seedoffset=0, sense=1, dtype=np.float64,
-                split="auto") -> ScenarioBatch:
-    """Vectorized batch builder: constructs all S scenarios' arrays at
-    once (the host-side 'scenario_creator loop' collapsed — reference
-    spbase.py:255-273 builds models one-by-one; here model build is a
-    numpy broadcast).
+def scenario_block(indices, crops_multiplier=1, use_integer=False,
+                   seedoffset=0, sense=1, dtype=np.float64,
+                   split="auto") -> ScenarioBatch:
+    """Vectorized batch builder over an ARBITRARY index set: constructs
+    exactly the scenarios named by `indices` (the host-side
+    'scenario_creator loop' collapsed — reference spbase.py:255-273
+    builds models one-by-one; here model build is a numpy broadcast).
+    Scenario i's data depends only on its GLOBAL index (yields from
+    RandomState(i + seedoffset)), so blocks are pure functions of their
+    index set — the `streaming.GeneratorSource` contract.  Block
+    probabilities are block-uniform (each block is a valid sampled
+    batch on its own); `build_batch` is the contiguous full-universe
+    special case.
 
     split: store A split-native (ir.SplitA — one shared (M, N) matrix
     plus the 2*nc per-scenario yield coefficients) instead of the dense
@@ -78,15 +84,16 @@ def build_batch(num_scens, crops_multiplier=1, use_integer=False,
     crops_multiplier=1000 — reference
     paperruns/scripts/farmer/ef_1000_1000.out) is ~288 GB dense f32 and
     only exists split-native."""
+    idx = np.asarray(indices, dtype=np.int64)
     nc = 3 * crops_multiplier
     N = 4 * nc
     M = 2 * nc + 1
-    S = num_scens
+    S = idx.size
     if split == "auto":
         split = S * M * N * np.dtype(dtype).itemsize > 1 << 30
 
     yields = np.stack([
-        scenario_yields(i, crops_multiplier, seedoffset) for i in range(S)
+        scenario_yields(int(i), crops_multiplier, seedoffset) for i in idx
     ]).astype(dtype)                                      # (S, nc)
 
     iac = np.arange(nc)
@@ -183,7 +190,7 @@ def build_batch(num_scens, crops_multiplier=1, use_integer=False,
         num_nodes=1,
         stage_of=(1,) * nc,
         nonant_names=var_names[:nc],
-        scen_names=tuple(f"scen{i}" for i in range(S)),
+        scen_names=tuple(f"scen{int(i)}" for i in idx),
     )
     # the ONLY scenario-varying matrix entries are the 2*nc yield
     # coefficients (feed rows r x iac, limit-sold rows r2 x iac);
@@ -201,6 +208,38 @@ def build_batch(num_scens, crops_multiplier=1, use_integer=False,
         var_names=var_names,
         model_meta={"A_delta_idx": (delta_rows, delta_cols)},
     )
+
+
+def build_batch(num_scens, crops_multiplier=1, use_integer=False,
+                seedoffset=0, sense=1, dtype=np.float64,
+                split="auto") -> ScenarioBatch:
+    """The full scenario universe [0, num_scens) — `scenario_block`
+    over the contiguous index range (bit-identical to the historical
+    builder: scenario data is a function of the global index only)."""
+    return scenario_block(np.arange(num_scens),
+                          crops_multiplier=crops_multiplier,
+                          use_integer=use_integer, seedoffset=seedoffset,
+                          sense=sense, dtype=dtype, split=split)
+
+
+def scenario_source(num_scens, cfg=None):
+    """streaming.ScenarioSource over the farmer universe — blocks are
+    built split-native by default so the shared constraint matrix is
+    never replicated per block (override with cfg["split"])."""
+    cfg = dict(cfg or {})
+    kw = {
+        "crops_multiplier": int(cfg.get("crops_multiplier", 1)),
+        "use_integer": bool(cfg.get(
+            "use_integer", cfg.get("farmer_with_integers", False))),
+        "seedoffset": int(cfg.get("start_seed", cfg.get("seedoffset", 0))),
+        "sense": int(cfg.get("sense", 1)),
+        "split": cfg.get("split", True),
+    }
+    from ..streaming import GeneratorSource
+    return GeneratorSource(
+        "farmer", int(num_scens),
+        lambda idx: scenario_block(idx, **kw),
+        name_fn=lambda i: f"scen{i}")
 
 
 def scenario_creator(scenario_name, use_integer=False, sense=1,

@@ -58,7 +58,7 @@ def wind_profile(scennum, H, seed=91):
 
 def build_batch(num_scens, H=6, n_units=None, seed=91,
                 fleet_multiplier=1, dtype=np.float64, shared_A=True,
-                min_up_down=False, reserve_factor=0.0):
+                min_up_down=False, reserve_factor=0.0, scens=None):
     """fleet_multiplier k replicates the 3-unit fleet k times with
     seeded parameter jitter and scales demand to match — the scaling
     axis of the reference's larger_uc instances (paperruns/larger_uc:
@@ -80,10 +80,18 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
     merely expensive — which is what makes reserve bind the
     commitment the way the reference's egret UC reserves do.  Wind
     enters the row bound per scenario (like the balance rows), so
-    shared_A is preserved."""
+    shared_A is preserved.
+
+    scens: optional GLOBAL scenario index set; default the contiguous
+    universe [0, num_scens).  Scenario i's wind depends only on i
+    (wind_profile seeds RandomState(seed + 17*i)), so an arbitrary
+    index set yields exactly those scenarios' data — the streaming
+    block contract (`scenario_block` wraps this)."""
     if reserve_factor < 0:
         raise ValueError(
             f"reserve_factor must be >= 0, got {reserve_factor}")
+    scens = (np.arange(num_scens, dtype=np.int64) if scens is None
+             else np.asarray(scens, dtype=np.int64))
     fleet = _FLEET if n_units is None else _FLEET[:n_units]
     if fleet_multiplier > 1:
         rng = np.random.RandomState(seed + 5)
@@ -93,7 +101,7 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
             reps.append(fleet * jit)
         fleet = np.concatenate(reps, axis=0)
     G = len(fleet)
-    S = num_scens
+    S = scens.size
     Pmin, Pmax, ramp, cNL, cSU, cV = fleet.T
 
     # layout: [u (G*H) | s (G*H) | p (G*H) | sh (H)], unit-major blocks
@@ -149,8 +157,8 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
             row_lo[:, r] = 0.0
             r += 1
     dem = demand_profile(H) * fleet_multiplier
-    wind = np.stack([wind_profile(s, H, seed)
-                     for s in range(S)]) * fleet_multiplier
+    wind = np.stack([wind_profile(int(s), H, seed)
+                     for s in scens]) * fleet_multiplier
     for h in range(H):                     # balance
         for g in range(G):
             A[:, r, pidx(g, h)] = 1.0
@@ -246,7 +254,7 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
         num_nodes=1,
         stage_of=(1,) * (2 * G * H),
         nonant_names=var_names[: 2 * G * H],
-        scen_names=tuple(f"Scenario{i+1}" for i in range(S)),
+        scen_names=tuple(f"Scenario{int(i)+1}" for i in scens),
     )
     return ScenarioBatch(
         c=c, qdiag=np.zeros((S, N), dtype=dtype),
@@ -258,6 +266,32 @@ def build_batch(num_scens, H=6, n_units=None, seed=91,
                     "uc_ut": ut, "uc_dt": dt_,
                     "uc_min_up_down": bool(min_up_down),
                     "uc_reserve_factor": float(reserve_factor)})
+
+
+def scenario_block(indices, num_scens=None, **kwargs):
+    """Build exactly the scenarios named by `indices` (global ids) —
+    the streaming block contract.  num_scens is accepted and ignored
+    (the universe size lives on the ScenarioSource); all other kwargs
+    are build_batch's."""
+    idx = np.asarray(indices, dtype=np.int64)
+    return build_batch(idx.size, scens=idx, **kwargs)
+
+
+def scenario_source(num_scens, cfg=None):
+    """streaming.ScenarioSource over the UC wind universe.  The
+    constraint matrix is scenario-independent (shared_A), so every
+    streamed block reuses the one shared (1, M, N) matrix — and the
+    driver's shared-A fast path rescales row bounds instead of
+    re-running Ruiz per block."""
+    cfg = dict(cfg or {})
+    kw = {k: cfg[k] for k in
+          ("H", "n_units", "seed", "fleet_multiplier", "shared_A",
+           "min_up_down", "reserve_factor") if k in cfg}
+    from ..streaming import GeneratorSource
+    return GeneratorSource(
+        "uc", int(num_scens),
+        lambda idx: scenario_block(idx, **kw),
+        name_fn=lambda i: f"Scenario{i+1}")
 
 
 def scenario_names_creator(num_scens, start=0):

@@ -315,6 +315,24 @@ def _gather_prep(prep: PreparedBatch, ii) -> PreparedBatch:
         anorm=take(prep.anorm))
 
 
+def reprep_row_bounds(prep: PreparedBatch, row_lo, row_hi) -> PreparedBatch:
+    """Rebuild a PreparedBatch's scaled row bounds from new RAW bounds.
+
+    Valid exactly when the constraint operator is UNCHANGED — the Ruiz
+    scaling and the norm estimate depend only on A, so a batch whose
+    uncertainty lives entirely in the row bounds (shared-A families:
+    UC wind) can reuse one prep for every scenario block and pay only
+    this O(S*M) rescale per block.  The streaming layer's shared-A
+    block path is built on this; `_shift_and_widen_rows` (spopt xhat)
+    is the same identity for shifted bounds."""
+    return dataclasses.replace(
+        prep,
+        row_lo=jnp.where(jnp.isfinite(row_lo),
+                         row_lo * prep.d_row, row_lo),
+        row_hi=jnp.where(jnp.isfinite(row_hi),
+                         row_hi * prep.d_row, row_hi))
+
+
 def _unscale_A(A, dr, dc):
     """User-space view of a scaled constraint operator: A / dr / dc,
     dispatching on representation (dense batched / shared / SplitA /

@@ -147,6 +147,111 @@ def load_run_checkpoint(path, opt):
     return z
 
 
+def save_stream_checkpoint(path, sph):
+    """Atomically persist a StreamingPH run (streaming/streaming_ph.py).
+
+    The streamed trajectory is a function of (host-resident W, x_na,
+    solved mask, consensus xbar, the sampler's RNG state + active
+    sample size, the already-drawn next block, and the certification
+    cursor) — all host numpy, so the payload never touches jax.
+    Restoring every field and re-prefetching the pending block makes
+    the resumed trajectory bit-replay the uninterrupted one (asserted
+    in tests/test_streaming.py)."""
+    if sph.state is None:
+        raise RuntimeError("cannot checkpoint before Iter0 (no state)")
+    import json
+
+    samp = sph.sampler.state()
+    warm = sph._warm_host  # (x_full, y_full) or None
+    payload = {
+        "stream_format": np.int64(1),
+        "W_host": np.asarray(sph.W_host),
+        "x_na_host": np.asarray(sph.x_na_host),
+        "solved": np.asarray(sph.solved),
+        "xbar_host": np.asarray(sph.xbar_host),
+        "conv": np.float64(sph.conv),
+        "it": np.int64(int(sph.state.it)),
+        "active_n": np.int64(samp["active_n"]),
+        "est_rounds": np.int64(samp["est_rounds"]),
+        "rng_state": np.array(samp["rng_state"]),  # json string
+        "pending_indices": np.asarray(sph._pending_indices,
+                                      dtype=np.int64),
+        "est_seed": np.int64(sph._est_seed),
+        "est_history": np.array(json.dumps(sph._est_history)),
+        "trivial_bound": _opt_float(getattr(sph, "trivial_bound", None)),
+        "best_bound": _opt_float(getattr(sph, "best_bound", None)),
+        "ladder_eps": _opt_float(getattr(sph, "_ladder_eps", None)
+                                 if getattr(sph, "_ladder", None)
+                                 is not None else None),
+        "nonant_names": (
+            np.array(sph.batch.tree.nonant_names, dtype=object)
+            if sph.batch.tree.nonant_names
+            else np.array([], dtype=object)),
+        "warm_x": (np.asarray(warm[0]) if warm is not None
+                   else np.array([])),
+        "warm_y": (np.asarray(warm[1]) if warm is not None
+                   else np.array([])),
+    }
+    real = _norm_npz(path)
+    tmp = real + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **payload)
+    os.replace(tmp, real)
+    return real
+
+
+def load_stream_checkpoint(path, sph):
+    """Install a stream checkpoint into `sph` (a StreamingPH).  Shape/
+    name validation mirrors load_run_checkpoint; the pending block is
+    NOT prefetched here — the caller re-issues the prefetch so the
+    stream worker rebuilds it from the stored indices (blocks are pure
+    functions of their index set)."""
+    import json
+
+    z = np.load(_norm_npz(path), allow_pickle=True)
+    if "stream_format" not in z:
+        raise ValueError(
+            f"{path} is a plain PH run checkpoint, not a stream "
+            "checkpoint (use PH.ph_main resume for it)")
+    W = np.asarray(z["W_host"])
+    S, K = sph.total_scens, sph.batch.num_nonants
+    if W.shape != (S, K):
+        raise ValueError(
+            f"stream checkpoint W{W.shape} does not match this source "
+            f"(S,K)=({S},{K})")
+    saved_names = tuple(np.asarray(z["nonant_names"]).tolist())
+    cur_names = tuple(sph.batch.tree.nonant_names or ())
+    if saved_names and cur_names and saved_names != cur_names:
+        raise ValueError(
+            "stream checkpoint nonant names do not match this model: "
+            f"{saved_names[:3]}... vs {cur_names[:3]}...")
+    sph.W_host = W.copy()
+    sph.x_na_host = np.asarray(z["x_na_host"]).copy()
+    sph.solved = np.asarray(z["solved"]).copy()
+    sph.xbar_host = np.asarray(z["xbar_host"]).copy()
+    sph.conv = float(z["conv"])
+    sph.sampler.restore({
+        "active_n": int(z["active_n"]),
+        "est_rounds": int(z["est_rounds"]),
+        "rng_state": str(z["rng_state"]),
+    })
+    sph._pending_indices = np.asarray(z["pending_indices"],
+                                      dtype=np.int64)
+    sph._est_seed = int(z["est_seed"])
+    sph._est_history = json.loads(str(z["est_history"]))
+    sph.trivial_bound = _opt_load(z["trivial_bound"])
+    sph.best_bound = _opt_load(z["best_bound"])
+    if getattr(sph, "_ladder", None) is not None:
+        lad_eps = _opt_load(z["ladder_eps"])
+        if lad_eps is not None:
+            sph._ladder_eps = min(sph._ladder_eps, lad_eps)
+    wx = np.asarray(z["warm_x"])
+    sph._warm_host = ((wx, np.asarray(z["warm_y"])) if wx.size
+                      else None)
+    sph._install_resumed_state(int(z["it"]))
+    return z
+
+
 def restore_hub(path, hub):
     """Restore hub-level bound state (BestInner/OuterBound, incumbent)
     from a run checkpoint — the hub half of `resume_from=`."""

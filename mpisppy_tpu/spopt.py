@@ -87,7 +87,7 @@ class SPOpt(SPBase):
         self._promoted_cache = None
         self._np_cache = {}
 
-    def _build_prep(self, hot=None):
+    def _build_prep(self, hot=None, batch=None):
         """Ruiz scaling + ||A|| estimate over the batch constraint data.
 
         hot: a HOT_DTYPES key — cast A and the row bounds to that mode's
@@ -99,8 +99,14 @@ class SPOpt(SPBase):
         enough is converted to the BCOO-backed SparseSplitA afterward —
         Ruiz row/column scaling preserves the zero pattern, so the
         density measured post-scaling equals the structural density.
+
+        batch: prepare a DIFFERENT ScenarioBatch than self.batch with
+        the same routing (split-native / delta-split / dense) — the
+        streaming layer preps each sampled scenario block through here
+        so every pow2 block width hits the per-shape jit caches of the
+        prepare_* functions.
         """
-        b = self.batch
+        b = self.batch if batch is None else batch
         o = self.options
         A, row_lo, row_hi = b.A, b.row_lo, b.row_hi
         pair = (self.solver._hot_pair(jnp.asarray(b.c).dtype)
@@ -173,7 +179,8 @@ class SPOpt(SPBase):
     # -- hot path ---------------------------------------------------------
     def solve_loop(self, c=None, qdiag=None, lb=None, ub=None,
                    warm=True, dtiming=False, certify=False, eps=None,
-                   iters_cap=None):
+                   iters_cap=None, batch=None, prep=None, x0=None,
+                   y0=None):
         """Solve every scenario subproblem (batched).  Any of
         c/qdiag/lb/ub override the batch's own arrays (this is how PH,
         Lagrangian and xhat objectives/fixings are expressed).
@@ -182,6 +189,17 @@ class SPOpt(SPBase):
         TAG for a named cache — repeated bound evaluations (xhat,
         Lagrangian) warm-start from their own previous solve instead
         of going cold (the persistent-solver analog, spopt.py:877).
+
+        batch/prep: solve a DIFFERENT ScenarioBatch than self.batch
+        (the streaming layer's sampled blocks).  A block solve must
+        bring its own prep (the Ruiz scaling belongs to the block's
+        constraint data) and manages warm starts explicitly via
+        x0/y0 — the instance warm caches are shaped for self.batch, so
+        block solves neither read nor write them.  certify is
+        unsupported on block solves (`_certified_resolve` scatters
+        into self.batch-shaped results).
+
+        x0/y0: explicit warm-start point; overrides the warm cache.
 
         certify: drive scenarios to the KKT tolerance via a float64
         re-solve.  Scenarios the fast (typically f32) batched solve
@@ -203,20 +221,41 @@ class SPOpt(SPBase):
 
         Returns the ops.pdhg.SolveResult.
         """
-        b = self.batch
+        if batch is not None:
+            if prep is None:
+                raise ValueError(
+                    "solve_loop(batch=...) requires an explicit prep "
+                    "for the block's constraint data")
+            if certify:
+                raise ValueError(
+                    "certify is not supported on block solves "
+                    "(batch=...): _certified_resolve scatters into "
+                    "self.batch-shaped results")
+        b = self.batch if batch is None else batch
         t0 = time.time()
         tel = self._tel
         tn0 = time.monotonic_ns() if tel.enabled else 0
-        if isinstance(warm, str):
+        if batch is not None:
+            cache = (x0, y0)
+        elif isinstance(warm, str):
             cache = self._named_warm.get(warm, (None, None))
         else:
             cache = (self._x_warm, self._y_warm) if warm else (None, None)
+            if x0 is not None or y0 is not None:
+                cache = (x0, y0)
         eps_arg = self.solver_eps if eps is None else eps
-        # hot-dtype promotion: once the requested tolerance crosses the
-        # low-precision eps floor, route this solve through the
-        # full-precision pair (monotone under the ladder/Gapper
-        # schedules, so this re-routes at most once per run)
-        solver, prep = self.active_solver_prep(eps_arg)
+        if prep is not None:
+            # explicit prep (streaming block solves): hot-dtype
+            # promotion does not apply — the caller chose the prep's
+            # dtype, and a promoted solver with a mismatched-dtype
+            # prep would silently recompile per call
+            solver = self.solver
+        else:
+            # hot-dtype promotion: once the requested tolerance crosses
+            # the low-precision eps floor, route this solve through the
+            # full-precision pair (monotone under the ladder/Gapper
+            # schedules, so this re-routes at most once per run)
+            solver, prep = self.active_solver_prep(eps_arg)
         dens = self._prep_density(prep)
         args = (prep,
                 b.c if c is None else c,
@@ -271,7 +310,9 @@ class SPOpt(SPBase):
                 select = np.asarray(res.pres) >= tol
             res = self._certified_resolve(res, c, qdiag, lb, ub,
                                           select=select)
-        if isinstance(warm, str):
+        if batch is not None:
+            pass  # block solves never clobber the self.batch-shaped caches
+        elif isinstance(warm, str):
             self._named_warm[warm] = (res.x, res.y)
         elif warm:
             self._x_warm = res.x

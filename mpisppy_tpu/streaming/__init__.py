@@ -1,0 +1,40 @@
+"""streaming — minibatch randomized PH for million-scenario problems.
+
+The scenario universe never materializes on device (or even on host):
+a `ScenarioSource` builds scenario blocks on demand from their index
+sets, a `ScenarioStream` double-buffers block build + host->device
+transfer behind the solves, an `AdaptiveSampler` grows the active
+sample along a BM/BPL sequential-sampling schedule, and `StreamingPH`
+runs randomized PH supersteps over sampled blocks with full-S dual
+weights host-resident — stopping when the gap estimate certifies a
+confidence interval.  doc/src/streaming.md is the chapter.
+
+Import layering (AST-guarded in tests/test_streaming.py): this package
+and its host-path modules (source, stream, sampler) never import jax
+at module level — `StreamingPH` itself is loaded lazily on first
+attribute access.
+"""
+
+from .sampler import AdaptiveSampler
+from .source import (BatchSource, GeneratorSource, ScenarioSource,
+                     gather_block, source_for_module)
+from .stream import ScenarioStream, StreamClosed
+
+__all__ = [
+    "AdaptiveSampler",
+    "BatchSource",
+    "GeneratorSource",
+    "ScenarioSource",
+    "ScenarioStream",
+    "StreamClosed",
+    "StreamingPH",
+    "gather_block",
+    "source_for_module",
+]
+
+
+def __getattr__(name):
+    if name == "StreamingPH":
+        from .streaming_ph import StreamingPH
+        return StreamingPH
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

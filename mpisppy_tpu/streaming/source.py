@@ -1,0 +1,179 @@
+"""ScenarioSource — the seed-indexed scenario factory protocol.
+
+The models already carry the pattern informally: `farmer.
+scenario_yields(scennum, seedoffset)` draws scenario `scennum`'s data
+from `RandomState(scennum + seedoffset)`, so ANY subset of the
+scenario universe can be materialized from its index set alone.  This
+module promotes that into a protocol the streaming layer can drive:
+
+  * `ScenarioSource`    — abstract: `block(indices) -> ScenarioBatch`
+    materializing exactly those scenarios (block-uniform probabilities
+    summing to 1, so each block is a valid sampled batch on its own);
+  * `GeneratorSource`   — wraps an index-parameterized builder (the
+    `scenario_block(indices, **kw)` functions in models/farmer.py and
+    models/uc.py); the full S-scenario tensor NEVER materializes, which
+    is what opens S=1,000,000 runs;
+  * `BatchSource`       — wraps an already-built ScenarioBatch (host-
+    resident shard) and gathers blocks out of it — the fallback for
+    models without an index-parameterized builder (models/aircond.py's
+    tree build) and for tests comparing streamed vs. resident runs.
+
+Laziness contract (AST-guarded in tests/test_streaming.py): this
+module never imports jax at module level — block construction runs on
+the stream's worker thread against host numpy, and the host side of
+the pipeline must be importable (and cheap) without touching the
+accelerator runtime.  The `ScenarioBatch` container type is imported
+lazily inside the functions that construct one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+class ScenarioSource:
+    """Protocol: materialize scenario blocks of a fixed universe on
+    demand.  `total_scens` is the size of the scenario universe S;
+    `block(indices)` returns a ScenarioBatch holding exactly those
+    scenarios with BLOCK-uniform probabilities (each block is a valid
+    sampled batch: probs sum to 1, so SPBase accepts it and
+    expectations over a block are sample means)."""
+
+    name = "source"
+    total_scens = 0
+
+    def block(self, indices):
+        raise NotImplementedError
+
+    def names(self, indices):
+        """Scenario names of an index set (default: the batch's own)."""
+        return list(self.block(np.asarray(indices)).tree.scen_names)
+
+
+class GeneratorSource(ScenarioSource):
+    """A source backed by an index-parameterized builder function —
+    `block_fn(indices) -> ScenarioBatch` (models expose these as
+    `scenario_block`; `source_for_module` wires the kwargs).  Blocks
+    are pure functions of the index set: the builders seed per-scenario
+    RNG from the GLOBAL index (`RandomState(i + seedoffset)`), so
+    scenario i's data is identical no matter which block it rides in —
+    the property checkpoint/resume and the parity tests lean on."""
+
+    def __init__(self, name, total_scens, block_fn, name_fn=None):
+        self.name = name
+        self.total_scens = int(total_scens)
+        self._block_fn = block_fn
+        self._name_fn = name_fn
+
+    def block(self, indices):
+        idx = np.asarray(indices, dtype=np.int64)
+        if idx.size == 0:
+            raise ValueError("empty scenario block")
+        if idx.min() < 0 or idx.max() >= self.total_scens:
+            raise IndexError(
+                f"block indices out of range [0, {self.total_scens})")
+        return self._block_fn(idx)
+
+    def names(self, indices):
+        if self._name_fn is not None:
+            return [self._name_fn(int(i)) for i in np.asarray(indices)]
+        return super().names(indices)
+
+
+def gather_block(batch, indices):
+    """Gather a scenario block out of a materialized ScenarioBatch —
+    host-side numpy throughout (no jax); probabilities renormalized to
+    the block, tree node ids relabeled to the block's own compact node
+    universe.
+
+    Leaf policy mirrors parallel/mesh.py's sharding table: scenario-
+    leading arrays gather on axis 0; a shared constraint block
+    (A.shape[0]==1) passes through unreplicated; a SplitA gathers its
+    per-scenario delta values ONLY (the shared matrix + coordinates —
+    dense or BCOO — serve every block as-is, the 'never replicate the
+    shared block' residency contract); stage_cost_c gathers on its
+    scenario axis 1."""
+    from ..ir import ScenarioBatch, SplitA, TreeInfo
+
+    idx = np.asarray(indices, dtype=np.int64)
+    A = batch.A
+    if isinstance(A, SplitA):
+        A = dataclasses.replace(A, vals=np.asarray(A.vals)[idx])
+    elif np.asarray(A).shape[0] == 1 and batch.num_scens > 1:
+        pass                                   # shared: no gather
+    else:
+        A = np.asarray(A)[idx]
+    tree = batch.tree
+    node_sub = np.asarray(tree.node_of)[idx]
+    uniq, inv = np.unique(node_sub, return_inverse=True)
+    prob_sub = np.asarray(tree.prob, np.float64)[idx]
+    tot = prob_sub.sum()
+    prob_sub = (prob_sub / tot if tot > 0
+                else np.full(idx.size, 1.0 / idx.size))
+    sub_tree = TreeInfo(
+        node_of=inv.reshape(node_sub.shape).astype(np.int32),
+        prob=prob_sub,
+        num_nodes=int(uniq.size),
+        stage_of=tree.stage_of,
+        nonant_names=tree.nonant_names,
+        scen_names=tuple(np.asarray(tree.scen_names, dtype=object)[idx])
+        if tree.scen_names else (),
+    )
+    take = lambda a: None if a is None else np.asarray(a)[idx]  # noqa: E731
+    return ScenarioBatch(
+        c=take(batch.c), qdiag=take(batch.qdiag), A=A,
+        row_lo=take(batch.row_lo), row_hi=take(batch.row_hi),
+        lb=take(batch.lb), ub=take(batch.ub),
+        obj_const=take(batch.obj_const),
+        nonant_idx=np.asarray(batch.nonant_idx),
+        integer_mask=take(batch.integer_mask),
+        tree=sub_tree,
+        stage_cost_c=(np.asarray(batch.stage_cost_c)[:, idx]
+                      if batch.stage_cost_c is not None else None),
+        var_prob=take(batch.var_prob),
+        var_names=batch.var_names,
+        model_meta=batch.model_meta,
+    )
+
+
+class BatchSource(ScenarioSource):
+    """A source over an already-materialized ScenarioBatch: blocks are
+    gathered views (host numpy copies) of the resident arrays.  This
+    is the adapter for models whose scenario universe is built as one
+    coupled object (aircond's scenario tree) and the reference source
+    for full-S vs. streamed parity tests."""
+
+    def __init__(self, batch, name="batch"):
+        self.name = name
+        self.batch = batch
+        self.total_scens = int(batch.num_scens)
+
+    def block(self, indices):
+        idx = np.asarray(indices, dtype=np.int64)
+        if idx.size == 0:
+            raise ValueError("empty scenario block")
+        if idx.min() < 0 or idx.max() >= self.total_scens:
+            raise IndexError(
+                f"block indices out of range [0, {self.total_scens})")
+        return gather_block(self.batch, idx)
+
+    def names(self, indices):
+        names = self.batch.tree.scen_names
+        return [names[int(i)] for i in np.asarray(indices)]
+
+
+def source_for_module(module, num_scens, cfg=None):
+    """Build a ScenarioSource for a model module: the module's own
+    `scenario_source(num_scens, cfg)` hook when it has one (farmer, uc,
+    aircond define it), else materialize the full batch once via the
+    module's `build_batch` and wrap it in a BatchSource."""
+    cfg = dict(cfg or {})
+    hook = getattr(module, "scenario_source", None)
+    if hook is not None:
+        return hook(num_scens, cfg)
+    from ..confidence_intervals.ciutils import sample_batch
+    batch = sample_batch(module, num_scens, cfg.get("start_seed", 0),
+                         cfg, {})
+    return BatchSource(batch, name=getattr(module, "__name__", "batch"))

@@ -1,0 +1,131 @@
+"""ScenarioStream — double-buffered block materialization.
+
+One daemon worker thread turns index sets into ready-to-solve blocks:
+
+    prefetch(indices)          enqueue a block build (non-blocking)
+    next_block()               blocking take of the OLDEST prefetched
+                               block -> (indices, block)
+
+The worker runs `source.block(indices)` (host numpy, models' RNG) and
+then the caller-supplied `transfer` callable — StreamingPH injects
+"pad to the compiled block width + place on the device mesh" there, so
+block i+1's host build AND its host->device transfer overlap block i's
+solve (the double-buffering the tentpole asks for).  A bounded output
+queue (default depth 2) backpressures the worker so at most two blocks
+ever sit in flight — peak host memory stays O(block), never O(S).
+
+Ordering: a single worker draining a FIFO — blocks come out in
+prefetch order, which is what makes the streamed trajectory a pure
+function of the prefetch sequence (checkpoint/resume replays it).
+
+Laziness contract (AST-guarded): no module-level jax import.  Any jax
+work happens inside the injected `transfer` callable, owned by the
+driver that runs on the accelerator anyway.  Telemetry instruments are
+null no-ops when disabled (zero-cost-when-off).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+
+
+class StreamClosed(RuntimeError):
+    pass
+
+
+class ScenarioStream:
+    """Prefetching block pipeline over a ScenarioSource."""
+
+    def __init__(self, source, transfer=None, max_prefetch=2,
+                 telemetry=None):
+        self.source = source
+        self.transfer = transfer
+        self._tel = (telemetry if telemetry is not None
+                     else _telemetry.get())
+        self._in = queue.Queue()
+        self._out = queue.Queue(maxsize=max(int(max_prefetch), 1))
+        self._closed = False
+        self.blocks_loaded = 0
+        self.scenarios_streamed = 0
+        self.prefetch_wait_s = 0.0
+        self._worker = threading.Thread(
+            target=self._run, name=f"scenario-stream-{source.name}",
+            daemon=True)
+        self._worker.start()
+
+    # -- worker -----------------------------------------------------------
+    def _run(self):
+        while True:
+            item = self._in.get()
+            if item is None:
+                self._out.put(None)
+                return
+            indices = item
+            try:
+                block = self.source.block(indices)
+                if self.transfer is not None:
+                    block = self.transfer(block)
+                self._out.put((indices, block, None))
+            except BaseException as e:  # surfaced on next_block()
+                self._out.put((indices, None, e))
+
+    # -- consumer API -----------------------------------------------------
+    def prefetch(self, indices):
+        """Enqueue a block build; returns immediately.  The worker
+        builds blocks in prefetch order."""
+        if self._closed:
+            raise StreamClosed("stream is closed")
+        self._in.put(np.asarray(indices, dtype=np.int64))
+
+    def next_block(self):
+        """Blocking take of the oldest prefetched block.  Records the
+        time spent waiting (stream.prefetch_wait_seconds — ~0 when the
+        build/transfer fully overlapped the previous solve) and
+        re-raises any worker-side build failure."""
+        if self._closed:
+            raise StreamClosed("stream is closed")
+        t0 = time.monotonic()
+        item = self._out.get()
+        wait = time.monotonic() - t0
+        if item is None:
+            raise StreamClosed("stream worker exited")
+        indices, block, err = item
+        if err is not None:
+            raise err
+        self.prefetch_wait_s += wait
+        self.blocks_loaded += 1
+        self.scenarios_streamed += int(indices.size)
+        if self._tel.enabled:
+            r = self._tel.registry
+            r.counter("stream.blocks_loaded").inc()
+            r.counter("stream.scenarios_streamed").inc(int(indices.size))
+            r.histogram("stream.prefetch_wait_seconds").observe(wait)
+        return indices, block
+
+    def close(self):
+        """Stop the worker (idempotent).  Pending prefetches are
+        abandoned."""
+        if self._closed:
+            return
+        self._closed = True
+        self._in.put(None)
+
+    def stats(self):
+        return {
+            "blocks_loaded": int(self.blocks_loaded),
+            "scenarios_streamed": int(self.scenarios_streamed),
+            "prefetch_wait_seconds": float(self.prefetch_wait_s),
+        }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -221,6 +221,31 @@ def pdhg_counters(registry=None):
     return out
 
 
+def stream_counters(registry=None):
+    """Streaming-layer counter dict for bench JSON (zeros when the run
+    had telemetry off — keys are stable either way): blocks loaded,
+    scenarios streamed through the host->device pipe, sample growth
+    events, the active-sample-size gauge, and the total seconds the
+    consumer spent blocked on prefetch (the double-buffering
+    effectiveness signal — near-zero means block i+1 loads fully
+    overlap block i's solve)."""
+    reg = registry if registry is not None else get().registry
+    names = ("stream.blocks_loaded", "stream.scenarios_streamed",
+             "stream.sample_growth_events", "stream.supersteps")
+    vals = ({k: c.value for k, c in reg._counters.items()}
+            if reg.enabled else {})
+    out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
+    g = (reg._gauges.get("stream.active_sample_size")
+         if reg.enabled else None)
+    out["stream_active_sample_size"] = (
+        int(g.value) if g is not None else 0)
+    h = (reg._histograms.get("stream.prefetch_wait_seconds")
+         if reg.enabled else None)
+    out["stream_prefetch_wait_seconds"] = (
+        float(h.total) if h is not None else 0.0)
+    return out
+
+
 def serve_counters(registry=None):
     """Serve-layer counter dict for bench JSON (zeros when the run had
     telemetry off — keys are stable either way)."""

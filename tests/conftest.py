@@ -47,3 +47,8 @@ def pytest_configure(config):
         "markers", "precision: mixed-precision hot-loop tests "
         "(hot_dtype, promotion, sparse matvecs, dtype-aware MFU); "
         "these RUN under tier-1's `-m 'not slow'`")
+    config.addinivalue_line(
+        "markers", "streaming: minibatch randomized-PH streaming tests "
+        "(ScenarioSource blocks, double-buffered stream, adaptive "
+        "sampler, StreamingPH parity/checkpoint); these RUN under "
+        "tier-1's `-m 'not slow'`")

@@ -1,0 +1,356 @@
+"""Streaming subsystem tests (mpisppy_tpu/streaming/): ScenarioSource
+block parity with the historical full-batch builders, gather/relabel
+semantics, the double-buffered ScenarioStream, AdaptiveSampler growth
+monotonicity + RNG round-trip, the SamplingRule/SeqSampling delegation
+equivalence, StreamingPH consensus parity with resident PH at small S,
+checkpoint/resume bit-parity, the peak-device-residency bound, and the
+AST guard that the host-path modules never import jax eagerly.
+"""
+
+import ast
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+import mpisppy_tpu.streaming as streaming_pkg
+from mpisppy_tpu import telemetry
+from mpisppy_tpu.confidence_intervals import ciutils
+from mpisppy_tpu.confidence_intervals.seqsampling import (SamplingRule,
+                                                          SeqSampling)
+from mpisppy_tpu.models import aircond, farmer, uc
+from mpisppy_tpu.streaming import (AdaptiveSampler, BatchSource,
+                                   GeneratorSource, ScenarioStream,
+                                   StreamClosed, gather_block,
+                                   source_for_module)
+from mpisppy_tpu.streaming.streaming_ph import StreamingPH
+
+pytestmark = pytest.mark.streaming
+
+
+# ---- ScenarioSource protocol / model scenario_block parity ---------------
+
+def test_farmer_scenario_block_is_build_batch_on_the_full_range():
+    b = farmer.build_batch(12, seedoffset=3)
+    bb = farmer.scenario_block(np.arange(12), seedoffset=3)
+    assert np.array_equal(np.asarray(b.A), np.asarray(bb.A))
+    assert np.array_equal(np.asarray(b.c), np.asarray(bb.c))
+    assert np.array_equal(np.asarray(b.ub), np.asarray(bb.ub))
+    assert b.tree.scen_names == bb.tree.scen_names
+
+
+def test_farmer_block_rows_match_global_scenarios():
+    full = farmer.build_batch(20)
+    blk = farmer.scenario_block(np.array([3, 7, 19]))
+    for j, i in enumerate((3, 7, 19)):
+        assert np.allclose(np.asarray(blk.A)[j], np.asarray(full.A)[i])
+    assert blk.tree.scen_names == ("scen3", "scen7", "scen19")
+    # block-uniform probabilities: each block is a valid sampled batch
+    assert abs(float(np.sum(np.asarray(blk.tree.prob))) - 1.0) < 1e-12
+
+
+def test_uc_block_rows_match_global_scenarios():
+    full = uc.build_batch(8)
+    blk = uc.scenario_block(np.array([2, 5]))
+    assert np.allclose(np.asarray(blk.row_lo)[0],
+                       np.asarray(full.row_lo)[2])
+    assert np.allclose(np.asarray(blk.row_lo)[1],
+                       np.asarray(full.row_lo)[5])
+    assert blk.tree.scen_names == ("Scenario3", "Scenario6")
+    assert blk.shared_A  # the shared matrix never replicates per block
+
+
+def test_generator_source_validates_indices():
+    src = source_for_module(farmer, 10, {})
+    assert isinstance(src, GeneratorSource)
+    assert src.total_scens == 10
+    with pytest.raises(ValueError):
+        src.block(np.array([], dtype=np.int64))
+    with pytest.raises(IndexError):
+        src.block(np.array([10]))
+    assert src.names(np.array([0, 9])) == ["scen0", "scen9"]
+
+
+def test_gather_block_relabels_tree_nodes_and_renormalizes():
+    src = aircond.scenario_source(None, {"branching_factors": (3, 2)})
+    assert isinstance(src, BatchSource)
+    blk = src.block(np.array([0, 3, 5]))
+    assert blk.num_scens == 3
+    assert abs(float(np.sum(np.asarray(blk.tree.prob))) - 1.0) < 1e-9
+    # node ids relabeled to the block's compact universe
+    node = np.asarray(blk.tree.node_of)
+    assert node.min() >= 0
+    assert node.max() < blk.tree.num_nodes
+    assert blk.tree.num_nodes <= len(np.unique(node)) + 0 or True
+    assert blk.tree.num_nodes == len(np.unique(node))
+
+
+def test_gather_block_keeps_splitA_shared_block_unreplicated():
+    from mpisppy_tpu.ir import SplitA
+    full = farmer.build_batch(16, split=True)
+    blk = gather_block(full, np.array([1, 4, 9]))
+    assert isinstance(blk.A, SplitA)
+    # shared matrix is the SAME object (never gathered/replicated)
+    assert blk.A.shared is full.A.shared
+    assert np.asarray(blk.A.vals).shape[0] == 3
+    assert np.allclose(np.asarray(blk.A.vals)[2],
+                       np.asarray(full.A.vals)[9])
+
+
+# ---- ScenarioStream -------------------------------------------------------
+
+def test_stream_preserves_prefetch_order_and_counts():
+    src = source_for_module(farmer, 32, {})
+    with ScenarioStream(src) as st:
+        st.prefetch([0, 1, 2])
+        st.prefetch([10, 11])
+        i1, b1 = st.next_block()
+        i2, b2 = st.next_block()
+    assert list(i1) == [0, 1, 2] and b1.num_scens == 3
+    assert list(i2) == [10, 11] and b2.num_scens == 2
+    s = st.stats()
+    assert s["blocks_loaded"] == 2 and s["scenarios_streamed"] == 5
+    assert s["prefetch_wait_seconds"] >= 0.0
+
+
+def test_stream_surfaces_worker_errors_and_close_is_idempotent():
+    src = source_for_module(farmer, 8, {})
+    st = ScenarioStream(src)
+    st.prefetch([99])                     # out of range -> worker error
+    with pytest.raises(IndexError):
+        st.next_block()
+    st.close()
+    st.close()
+    with pytest.raises(StreamClosed):
+        st.prefetch([0])
+
+
+# ---- AdaptiveSampler ------------------------------------------------------
+
+def test_sampler_growth_is_monotone_and_capped():
+    # moderate h -> n_1 well under the universe, and the BM schedule's
+    # 2p*ln^2(k) term demands a strictly larger n_k every few rounds
+    rule = SamplingRule({"BM_h": 0.3, "BM_hprime": 0.0,
+                         "BM_eps": 1e-12, "n0min": 4})
+    smp = AdaptiveSampler(rule, total_scens=500, block_size=8, seed=1)
+    sizes = [smp.active_n]
+    for _ in range(6):
+        done = smp.observe(G=1e9, s=1.0)   # huge gap: never certifies
+        assert done is False
+        sizes.append(smp.active_n)
+    assert sizes == sorted(sizes)                 # monotone growth
+    assert sizes[-1] > sizes[0]                   # actually grew
+    assert all(n <= 500 for n in sizes)           # capped at universe
+    assert smp.growth_events >= 1
+    idx = smp.draw_block()
+    assert idx.size == 8 and np.all(np.diff(idx) > 0)
+    assert idx.max() < smp.active_n
+
+
+def test_sampler_rng_state_roundtrip_replays_draws():
+    rule = SamplingRule({"n0min": 16})
+    a = AdaptiveSampler(rule, 100, block_size=8, seed=7)
+    a.draw_block()
+    saved = a.state()
+    b = AdaptiveSampler(rule, 100, block_size=8, seed=0)
+    b.restore(saved)
+    assert np.array_equal(a.draw_block(), b.draw_block())
+    assert a.state()["rng_state"] == b.state()["rng_state"]
+
+
+def test_sampling_rule_matches_seqsampling_delegation():
+    opts = {"BM_h": 1.2, "BM_hprime": 0.3, "BM_eps": 0.5, "n0min": 9}
+    rule = SamplingRule(opts)
+    seq = SeqSampling("mpisppy_tpu.models.farmer", opts)
+    for (k, G, s, nk) in [(1, None, None, None), (2, 10.0, 4.0, 9),
+                          (3, 2.0, 1.0, 20)]:
+        assert rule.sample_size(k, G, s, nk) == \
+            seq._sample_size(k, G, s, nk)
+    for (G, s, nk) in [(10.0, 1.0, 9), (0.1, 1.0, 30)]:
+        assert rule.should_continue(G, s, nk) == seq._continue(G, s, nk)
+    assert rule.ci_upper(2.0) == seq.rule.ci_upper(2.0)
+
+
+# ---- StreamingPH ----------------------------------------------------------
+
+def _stream_opts(**kw):
+    o = {"PHIterLimit": 6, "defaultPHrho": 1.0, "solver_eps": 1e-6,
+         "stream_block_size": 8, "stream_check_every": 100,
+         "stream_seed": 0}
+    o.update(kw)
+    return o
+
+
+def test_streaming_ph_peak_residency_bounded_by_block_width():
+    S = 64
+    src = source_for_module(farmer, S, {})
+    sph = StreamingPH(_stream_opts(PHIterLimit=3), src, module=None)
+    sph.stream_main(finalize=False)
+    st = sph.stream_stats()
+    # the residency acceptance bound: device scenario residency never
+    # exceeds the configured (bucketed) block width, which is << S
+    assert st["peak_block_scens"] <= st["block_width"]
+    assert sph.batch.num_scens == st["block_width"]
+    assert st["block_width"] < S
+    assert st["sampled_scenarios"] <= S
+    # the solved mask stays inside the active prefix
+    assert not sph.solved[sph.sampler.active_n:].any()
+
+
+def test_streaming_ph_reaches_full_ph_consensus_and_verdict():
+    """Streamed randomized PH at small S lands on the same consensus
+    region as resident PH.ph_main, and the SAME certification rule
+    reaches the SAME verdict on both candidates (matched estimator
+    seed), which is the acceptance's 'same certified verdict'."""
+    from mpisppy_tpu.opt.ph import PH
+
+    S = 24
+    batch = farmer.build_batch(S)
+    ph = PH({"PHIterLimit": 30, "defaultPHrho": 1.0,
+             "convthresh": 1e-3, "solver_eps": 1e-6},
+            [f"scen{i}" for i in range(S)], batch=batch)
+    ph.ph_main()
+    xbar_full = np.asarray(ph.root_xbar())
+
+    src = BatchSource(batch, name="farmer24")
+    sph = StreamingPH(
+        _stream_opts(PHIterLimit=25, stream_block_size=8,
+                     stream_check_every=5,
+                     BM_h=2.0, BM_hprime=0.4, BM_eps=200.0),
+        src, module=farmer)
+    sph.stream_main(finalize=False)
+    xbar_stream = sph.xbar_host
+
+    # consensus parity: same region of the acreage simplex
+    denom = max(float(np.abs(xbar_full).max()), 1.0)
+    assert np.abs(xbar_stream - xbar_full).max() / denom < 0.15
+
+    # identical rule + estimator seed -> identical certified verdict
+    rule = SamplingRule({"BM_h": 2.0, "BM_hprime": 0.4, "BM_eps": 200.0})
+    cfg = {"solver_eps": 1e-6}
+    nk = 16
+    verdicts = []
+    for cand in (xbar_stream, xbar_full):
+        est = ciutils.gap_estimators(cand, farmer, num_scens=nk,
+                                     seed=424242, cfg=cfg)
+        verdicts.append(
+            not rule.should_continue(est["G"], est["std"], nk))
+    assert verdicts[0] == verdicts[1]
+
+
+def test_streaming_ph_certifies_with_internal_rule():
+    src = source_for_module(farmer, 64, {})
+    sph = StreamingPH(
+        _stream_opts(PHIterLimit=25, stream_check_every=3,
+                     BM_h=2.0, BM_hprime=0.5, BM_eps=500.0),
+        src, module=farmer)
+    conv, eobj, trivial = sph.stream_main()
+    assert sph.certified is not None
+    ci = sph.certified["CI"]
+    assert ci[0] == 0.0 and ci[1] > 0.0
+    # the CI upper is exactly the rule's h*s + eps form
+    assert ci[1] == pytest.approx(
+        sph.rule.ci_upper(sph.certified["s"]))
+    assert np.isfinite(eobj) and np.isfinite(trivial)
+    st = sph.stream_stats()
+    assert st["ci_gap"] == ci
+
+
+def test_streaming_ph_checkpoint_resume_is_bit_equal(tmp_path):
+    batch = farmer.build_batch(24)
+
+    def mk(extra):
+        return StreamingPH(_stream_opts(**extra),
+                           BatchSource(batch, name="farmer24"),
+                           module=None)
+
+    a = mk({})
+    a.stream_main(finalize=False)
+
+    ck = os.fspath(tmp_path / "stream_ck")
+    b1 = mk({"PHIterLimit": 3, "run_checkpoint": ck,
+             "checkpoint_every": 1})
+    b1.stream_main(finalize=False)
+    b2 = mk({"resume_from": ck})
+    b2.stream_main(finalize=False)
+
+    assert np.array_equal(a.W_host, b2.W_host)
+    assert np.array_equal(a.x_na_host, b2.x_na_host)
+    assert np.array_equal(a.xbar_host, b2.xbar_host)
+    assert np.array_equal(a.solved, b2.solved)
+    assert a.conv == b2.conv
+    assert int(a.state.it) == int(b2.state.it)
+    # the sampler RNG and the in-flight draw replayed exactly
+    assert a.sampler.state()["rng_state"] == \
+        b2.sampler.state()["rng_state"]
+    assert np.array_equal(a._pending_indices, b2._pending_indices)
+
+
+def test_stream_checkpoint_rejects_plain_ph_format(tmp_path):
+    from mpisppy_tpu.resilience.checkpoint import load_stream_checkpoint
+    batch = farmer.build_batch(24)
+    sph = StreamingPH(_stream_opts(PHIterLimit=1),
+                      BatchSource(batch), module=None)
+    sph.stream_main(finalize=False)
+    p = os.fspath(tmp_path / "plain.npz")
+    np.savez(p, W=np.zeros((24, 3)))    # no stream_format marker
+    with pytest.raises(ValueError, match="plain PH run checkpoint"):
+        load_stream_checkpoint(p, sph)
+
+
+def test_streaming_ph_rejects_multistage_sources():
+    src = aircond.scenario_source(None, {"branching_factors": (3, 2)})
+    with pytest.raises(NotImplementedError, match="two-stage"):
+        StreamingPH(_stream_opts(), src, module=None)
+
+
+def test_streaming_ph_rejects_w_bounds():
+    src = source_for_module(farmer, 16, {})
+    sph = StreamingPH(_stream_opts(PHIterLimit=1), src, module=None)
+    with pytest.raises(NotImplementedError):
+        sph.check_W_bound_supported()
+
+
+# ---- telemetry + laziness guards ------------------------------------------
+
+def test_stream_counters_keys_stable_on_and_off():
+    keys = {"stream_blocks_loaded", "stream_scenarios_streamed",
+            "stream_sample_growth_events", "stream_supersteps",
+            "stream_active_sample_size",
+            "stream_prefetch_wait_seconds"}
+    off = telemetry.stream_counters(
+        telemetry.Telemetry({"enabled": False}).registry)
+    assert set(off) == keys
+    assert all(v == 0 for v in off.values())
+
+    tel = telemetry.Telemetry({"enabled": True})
+    src = source_for_module(farmer, 16, {})
+    st = ScenarioStream(src, telemetry=tel)
+    st.prefetch([0, 1, 2])
+    st.next_block()
+    st.close()
+    on = telemetry.stream_counters(tel.registry)
+    assert set(on) == keys
+    assert on["stream_blocks_loaded"] == 1
+    assert on["stream_scenarios_streamed"] == 3
+
+
+@pytest.mark.parametrize("mod", ["__init__.py", "source.py",
+                                 "stream.py", "sampler.py"])
+def test_streaming_host_modules_never_import_jax_eagerly(mod):
+    """AST guard (module-level statements only): the host-path modules
+    must be importable without pulling in the accelerator runtime —
+    jax is allowed only lazily inside functions (streaming_ph.py is
+    the accelerator-side driver and is exempt)."""
+    path = pathlib.Path(streaming_pkg.__file__).parent / mod
+    tree = ast.parse(path.read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            assert not any(a.name.split(".")[0] == "jax"
+                           for a in node.names), f"{mod}: import jax"
+        elif isinstance(node, ast.ImportFrom):
+            assert (node.module or "").split(".")[0] != "jax", \
+                f"{mod}: from jax import ..."
+            assert node.module != "mpisppy_tpu.streaming.streaming_ph", \
+                f"{mod}: eager import of the jax-backed driver"
