@@ -620,6 +620,119 @@ def worker_farmer_stream():
     print(json.dumps(out))
 
 
+def worker_wheel_mpmd():
+    """BENCH_MODEL=wheel_mpmd: the device-resident MPMD wheel
+    (mpisppy_tpu/mpmd/) — hub + Lagrangian + xhat cylinders on
+    DISJOINT mesh slices exchanging bound/xhat/W vectors through
+    device mailboxes instead of the host seqlock.  On a CPU landing
+    the fleet is faked to BENCH_MPMD_DEVICES (default 8) virtual
+    devices; on a multi-chip accelerator the real device list is
+    sliced.  `value` is the wall-clock to the hub's certified gap
+    termination (rel_gap), -1 if the iteration budget ran out first.
+    The JSON carries the MPMD-specific fields: n_slices,
+    exchange_latency_seconds (total device-mailbox transfer time),
+    hub_overlap_fraction (share of hub wall-clock covered by
+    concurrent spoke work on other slices), per-slice phase_seconds,
+    and the wheel.* telemetry counters.  A box with too few devices
+    for even 1-device slices degrades to a single-slice seqlock wheel
+    and says so in `note`."""
+    ndev = int(os.environ.get("BENCH_MPMD_DEVICES", 8))
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # must land before the first jax import in this process
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={ndev}"
+        ).strip()
+    from mpisppy_tpu.utils.platform import (enable_f64_if_cpu,
+                                            ensure_cpu_backend)
+    ensure_cpu_backend()
+    import jax
+
+    from mpisppy_tpu import telemetry
+    from mpisppy_tpu.cylinders.hub import PHHub
+    from mpisppy_tpu.cylinders.lagrangian_bounder import (
+        LagrangianOuterBound)
+    from mpisppy_tpu.cylinders.xhatshufflelooper_bounder import (
+        XhatShuffleInnerBound)
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.mpmd import MPMDWheel
+    from mpisppy_tpu.opt.ph import PH
+    from mpisppy_tpu.spin_the_wheel import WheelSpinner
+    from mpisppy_tpu.utils.xhat_eval import Xhat_Eval
+
+    on_tpu = not enable_f64_if_cpu()
+    S = int(os.environ.get("BENCH_SCENS", 100))
+    iters = int(os.environ.get("BENCH_ITERS", 40))
+    rel_gap = float(os.environ.get("BENCH_REL_GAP", 1e-4))
+    telemetry.configure(True)
+    names = [f"scen{i}" for i in range(S)]
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": iters,
+            "convthresh": 0.0, "pdhg_eps": 1e-7,
+            "pdhg_max_iters": 30000, "telemetry": True}
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": rel_gap, "abs_gap": 1.0}},
+        "opt_class": PH,
+        "opt_kwargs": {"options": opts, "all_scenario_names": names,
+                       "batch": farmer.build_batch(S)},
+    }
+    spoke_dicts = [
+        {"spoke_class": LagrangianOuterBound,
+         "spoke_kwargs": {"options": {}},
+         "opt_class": PH,
+         "opt_kwargs": {"options": dict(opts),
+                        "all_scenario_names": names}},
+        {"spoke_class": XhatShuffleInnerBound,
+         "spoke_kwargs": {"options": {}},
+         "opt_class": Xhat_Eval,
+         "opt_kwargs": {"options": dict(opts),
+                        "all_scenario_names": names}},
+    ]
+    note = None
+    n_devices = len(jax.devices())
+    if n_devices >= len(spoke_dicts) + 1:
+        ws = MPMDWheel(hub_dict, spoke_dicts)
+    else:
+        note = (f"{n_devices} device(s): too few for disjoint slices; "
+                "single-slice seqlock wheel")
+        ws = WheelSpinner(hub_dict, spoke_dicts, mode="threads",
+                          exchange_backend="seqlock")
+    t0 = time.time()
+    ws.spin()
+    wall = time.time() - t0
+    ob = float(ws.BestOuterBound)
+    ib = float(ws.BestInnerBound)
+    gap = abs(ib - ob) / max(1.0, abs(ib))
+    certified = gap <= rel_gap
+    counters = telemetry.wheel_counters()
+    plan = getattr(ws, "plan", None)
+    out = {
+        "metric": f"farmer{S}_wheel_mpmd_seconds_to_certified_gap",
+        "value": round(wall, 3) if certified else -1,
+        "unit": "s", "vs_baseline": 0,
+        "n_slices": plan.n_slices if plan is not None else 1,
+        "exchange_latency_seconds": round(
+            counters["wheel_exchange_latency_seconds"], 6),
+        "hub_overlap_fraction": round(
+            getattr(ws, "hub_overlap_fraction", 0.0), 4),
+        "phase_seconds": {
+            k: round(v, 4)
+            for k, v in getattr(ws, "slice_phase_seconds", {}).items()},
+        "best_outer": round(ob, 3), "best_inner": round(ib, 3),
+        "rel_gap": round(gap, 8), "certified": certified,
+        "slices": plan.describe() if plan is not None else [],
+        "device": jax.devices()[0].platform, "on_tpu": on_tpu,
+        "scens": S, "iters": iters,
+        **counters}
+    if not certified:
+        out["note"] = (f"gap {gap:.2e} > {rel_gap:g} after {iters} "
+                       "hub iterations")
+    if note:
+        out["note"] = note if "note" not in out \
+            else out["note"] + "; " + note
+    print(json.dumps(out))
+
+
 def worker():
     """The measured run (executes on whatever backend the env gives)."""
     model = os.environ.get("BENCH_MODEL", "farmer")
@@ -631,6 +744,8 @@ def worker():
         return worker_serve()
     if model == "farmer_stream":
         return worker_farmer_stream()
+    if model == "wheel_mpmd":
+        return worker_wheel_mpmd()
     import numpy as np
 
     from mpisppy_tpu.utils.platform import (enable_f64_if_cpu,

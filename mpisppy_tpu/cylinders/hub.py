@@ -140,11 +140,16 @@ class Hub(SPCommunicator):
                     self.nonant_idx_set.add(i)
             self.spoke_chars[i] = sp.converger_spoke_char
             prefix = self.options.get("window_path_prefix")
+            # per-spoke backend kwargs (keyed by spoke index) stay
+            # opaque here: the mpmd wheel passes device placements for
+            # its "device" pairs; the hub never learns mpmd specifics
+            bkw = self.options.get("window_backend_kwargs") or {}
             pair = WindowPair(
                 hub_length=sp.receive_length(),
                 spoke_length=sp.send_length(),
                 backend=self.options.get("window_backend", "python"),
-                path_prefix=None if prefix is None else f"{prefix}{i}")
+                path_prefix=None if prefix is None else f"{prefix}{i}",
+                backend_kwargs=bkw.get(i))
             sp.pair = pair
             self.pairs.append(pair)
         self._spoke_read_ids = np.zeros(len(self.spokes), np.int64)

@@ -76,20 +76,38 @@ class Window:
         but callers may close any backend uniformly."""
 
 
+# Exchange-backend registry — the seam through which alternative
+# window implementations (the mpmd device-mailbox exchange) plug in
+# WITHOUT this package importing them: mpisppy_tpu.mpmd registers its
+# "device" factory on import, and cylinders stay ignorant of jax and of
+# mpmd internals (guarded by tests/test_mpmd_wheel.py AST checks).
+_WINDOW_BACKENDS: dict = {}
+
+
+def register_window_backend(name, pair_factory):
+    """Register `pair_factory(hub_length, spoke_length, **kwargs) ->
+    (to_spoke, to_hub)` under `name` for WindowPair(backend=name)."""
+    _WINDOW_BACKENDS[name] = pair_factory
+
+
 class WindowPair:
     """The two windows of one hub<->spoke stratum: hub-owned (spoke
     reads) and spoke-owned (hub reads) — the analog of the two
     MPI.Win.Allocate buffers per pair (reference spcommunicator.py:93).
 
+    backend="python" (alias "seqlock") is the host mailbox above;
     backend="native" uses the C++ seqlock exchange
     (runtime/exchange.cpp): identical contract, lock-free reads, and
     mmap-file support for cross-process (DCN gateway) pairs via
-    `path_prefix`.
+    `path_prefix`.  Any other name resolves through the registered
+    backend factories (register_window_backend) with `backend_kwargs`
+    passed through opaquely — the "device" backend registered by
+    mpisppy_tpu.mpmd takes per-slice device placements this way.
     """
 
     def __init__(self, hub_length: int, spoke_length: int,
                  backend: str = "python", path_prefix: str | None = None,
-                 attach: bool = False):
+                 attach: bool = False, backend_kwargs: dict | None = None):
         if backend == "native":
             from ..runtime import NativeWindow
             pth = (lambda tag: None if path_prefix is None
@@ -102,9 +120,19 @@ class WindowPair:
                                          reset=not attach)
             self.to_hub = NativeWindow(spoke_length, path=pth("to_hub"),
                                        reset=not attach)
-        else:
+        elif backend in ("python", "seqlock"):
             self.to_spoke = Window(hub_length)
             self.to_hub = Window(spoke_length)
+        else:
+            factory = _WINDOW_BACKENDS.get(backend)
+            if factory is None:
+                raise RuntimeError(
+                    f"window backend {backend!r} is not registered "
+                    "(the 'device' backend registers on "
+                    "`import mpisppy_tpu.mpmd` — the WheelSpinner "
+                    "seam does this when it selects it)")
+            self.to_spoke, self.to_hub = factory(
+                hub_length, spoke_length, **(backend_kwargs or {}))
 
 
 class SPCommunicator:

@@ -181,6 +181,19 @@ class _BoundSpoke(Spoke):
         if self._got_bound:
             self.spoke_to_hub([self.bound])
 
+    def spoke_to_hub(self, values):
+        """Bound posts also feed the per-slice bound-progression gauge
+        (wheel.slice_bound.<track> — telemetry.wheel_counters), keyed
+        by this cylinder's trace track so every slice of an MPMD wheel
+        gets its own series.  Recorded pre-poison: the gauge reflects
+        the bound the spoke computed, chaos corrupts only the wire."""
+        super().spoke_to_hub(values)
+        if self.telemetry.enabled and len(values) \
+                and np.isfinite(values[0]):
+            track = self.telemetry_track or type(self).__name__
+            self.telemetry.gauge(
+                f"wheel.slice_bound.{track}").set(float(values[0]))
+
     def _append_trace(self, value):
         """Reference spoke.py:204 _append_trace."""
         if self._trace_path is None:

@@ -1,0 +1,35 @@
+"""mpmd — the wheel as a multi-chip MPMD program (doc/src/mpmd.md).
+
+Three pieces:
+
+  * `SlicePlan` (slice_plan.py) — partition the global device list
+    into disjoint per-cylinder submeshes (hub large, spokes small);
+  * `DeviceWindow` / `device_window_pair` (exchange.py) — versioned
+    device-resident mailboxes with the seqlock's write_id contract,
+    registered below as the "device" window backend;
+  * `MPMDWheel` + `SliceSupervisor` (wheel.py) — one controller thread
+    per slice, spoke supersteps overlapping hub supersteps, per-slice
+    supervision and telemetry.
+
+Importing this package is what makes WindowPair(backend="device")
+resolvable — the WheelSpinner seam imports it lazily when it selects
+the device exchange; cylinders/ itself never imports mpmd (AST-guarded
+by tests/test_mpmd_wheel.py).  jax stays lazy throughout: importing
+mpisppy_tpu.mpmd does not initialize the accelerator runtime.
+"""
+
+from ..cylinders.spcommunicator import register_window_backend
+from .exchange import DeviceWindow, device_window_pair
+from .slice_plan import CylinderSlice, SlicePlan
+from .wheel import MPMDWheel, SliceSupervisor
+
+register_window_backend("device", device_window_pair)
+
+__all__ = [
+    "CylinderSlice",
+    "DeviceWindow",
+    "MPMDWheel",
+    "SlicePlan",
+    "SliceSupervisor",
+    "device_window_pair",
+]

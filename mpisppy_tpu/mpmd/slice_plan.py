@@ -1,0 +1,141 @@
+"""SlicePlan — disjoint per-cylinder device slices.
+
+The reference wheel splits COMM_WORLD into a (cylinder x scenario) rank
+grid and gives every cylinder its own scenario-sharded communicator
+(spin_the_wheel.py:219-237 _make_comms).  The MPMD analog partitions
+the GLOBAL device list (parallel.distributed.init_multihost +
+jax.devices()) into disjoint submeshes: the hub — which runs the
+expensive PH supersteps over all scenarios — gets the large slice, and
+each bound/xhat spoke gets a small one (default 1 device), following
+the unequal-program placement of the MPMD pipelining work
+(arXiv:2412.14374).
+
+Slices expose `.mesh()` (a parallel.mesh.ScenarioMesh over their
+devices, built lazily so this module never touches jax at import time)
+and the plan exposes `pad_multiple()` — the lcm of the slice sizes —
+so ONE host batch padded to that multiple shards evenly on every
+slice, keeping the (S*K,) window lengths identical across cylinders.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class CylinderSlice:
+    """One cylinder's share of the fleet: `index` 0 is the hub."""
+
+    name: str
+    index: int
+    devices: tuple
+
+    @property
+    def n_devices(self):
+        return len(self.devices)
+
+    def mesh(self, axis_name="scen"):
+        from ..parallel.mesh import ScenarioMesh
+        return ScenarioMesh(devices=list(self.devices),
+                            axis_name=axis_name)
+
+
+class SlicePlan:
+    def __init__(self, slices):
+        slices = list(slices)
+        if not slices:
+            raise ValueError("a SlicePlan needs at least the hub slice")
+        seen = []
+        for s in slices:
+            if not s.devices:
+                raise ValueError(f"slice {s.name!r} has no devices")
+            for d in s.devices:
+                if d in seen:
+                    raise ValueError(
+                        f"device {d} appears in two slices — cylinder "
+                        "slices must be disjoint")
+                seen.append(d)
+        self.slices = slices
+        self.devices = seen            # union, in slice order
+
+    @property
+    def hub(self):
+        return self.slices[0]
+
+    @property
+    def spokes(self):
+        return self.slices[1:]
+
+    @property
+    def n_slices(self):
+        return len(self.slices)
+
+    def pad_multiple(self):
+        """lcm of the slice sizes: a batch padded to a multiple of this
+        shards evenly on EVERY slice, so no cylinder re-pads and the
+        flattened W/nonant window lengths agree across the wheel."""
+        return math.lcm(*(s.n_devices for s in self.slices))
+
+    @classmethod
+    def partition(cls, n_spokes, devices=None, spoke_devices=1,
+                  spoke_names=None):
+        """Hub-heavy partition of `devices` (default: the global
+        jax.devices() list): the last n_spokes*spoke_devices devices
+        become spoke slices, everything before them is the hub's
+        scenario slice."""
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        devices = list(devices)
+        need = n_spokes * spoke_devices + 1
+        if len(devices) < need:
+            raise ValueError(
+                f"{len(devices)} device(s) cannot host a hub plus "
+                f"{n_spokes} spoke slice(s) of {spoke_devices} — "
+                f"need at least {need}")
+        n_hub = len(devices) - n_spokes * spoke_devices
+        slices = [CylinderSlice("hub", 0, tuple(devices[:n_hub]))]
+        for j in range(n_spokes):
+            lo = n_hub + j * spoke_devices
+            name = (spoke_names[j] if spoke_names is not None
+                    else f"spoke{j}")
+            slices.append(CylinderSlice(
+                name, j + 1, tuple(devices[lo:lo + spoke_devices])))
+        return cls(slices)
+
+    @classmethod
+    def from_mesh(cls, mesh, n_spokes, spoke_devices=1, spoke_names=None):
+        """Partition an existing ScenarioMesh's device list, validating
+        each slice through `mesh.submesh` (membership check) — for a
+        2-D cylinder x scenario mesh with equal rows, `uniform` via
+        `slice_axis` is the natural alternative."""
+        plan = cls.partition(n_spokes, devices=mesh.devices,
+                             spoke_devices=spoke_devices,
+                             spoke_names=spoke_names)
+        for s in plan.slices:
+            mesh.submesh(s.devices)    # raises on foreign devices
+        return plan
+
+    @classmethod
+    def uniform(cls, mesh, spoke_names=None):
+        """One slice per cylinder row of a 2-D cylinder x scenario
+        ScenarioMesh (mesh.slice_axis) — equal-size slices, row 0 is
+        the hub."""
+        rows = mesh.slice_axis(mesh.cyl_axis)
+        if len(rows) < 2:
+            raise ValueError(
+                "uniform plans need a 2-D mesh with n_cyl >= 2")
+        slices = []
+        for r, sub in enumerate(rows):
+            name = ("hub" if r == 0 else
+                    spoke_names[r - 1] if spoke_names is not None
+                    else f"spoke{r - 1}")
+            slices.append(CylinderSlice(name, r, tuple(sub.devices)))
+        return cls(slices)
+
+    def describe(self):
+        """JSON-safe summary for logs / bench output."""
+        return [{"slice": s.index, "name": s.name,
+                 "devices": [str(d) for d in s.devices]}
+                for s in self.slices]

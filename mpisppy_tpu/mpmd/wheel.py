@@ -1,0 +1,356 @@
+"""MPMDWheel — the hub-and-spoke wheel as a multi-slice MPMD program.
+
+WheelSpinner timeshares ONE mesh: every cylinder's jitted programs
+queue on the same devices, so a spoke's Lagrangian pass and the hub's
+PH superstep serialize even in `threads` mode.  MPMDWheel instead
+gives each cylinder its own disjoint submesh from a SlicePlan (hub
+gets the large scenario slice, spokes get small ones) and runs one
+controller thread per slice — the single-controller analog of the
+multi-program placement in arXiv:2412.14374.  Spoke supersteps then
+genuinely overlap hub supersteps (hub_overlap_fraction measures how
+much), and bound/xhat/W vectors cross slice boundaries through the
+device-resident mailboxes of exchange.DeviceWindow rather than the
+host seqlock.
+
+Batch discipline: every cylinder lowers ONE host batch pre-padded to a
+multiple of `plan.pad_multiple()` (lcm of slice sizes), so each slice's
+ScenarioMesh shards it without further padding and the flattened
+(S*K,) window lengths agree across the wheel — the same invariant the
+multiproc path enforces with `pad_to` (spin_the_wheel._spin_multiproc).
+
+Supervision: SliceSupervisor is the in-process analog of
+resilience.SpokeSupervisor — crashed slice threads restart with the
+shared capped backoff (fresh chaos schedule, like a respawned process)
+until the restart budget is spent, then prune through the hub's
+`report_spoke_failure`/`_mark_spoke_failed` path; write_id staleness
+per slice feeds `wheel.slice_heartbeat_age.*` gauges and hang pruning.
+Telemetry tracks are per-slice, so the run exports ONE merged
+cross-slice trace exactly like the threaded wheel.
+
+jax stays import-lazy here (AST-guarded): the accelerator runtime
+initializes when the wheel spins, not when mpmd imports.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import global_toc
+from .. import telemetry as _telemetry
+from ..resilience.chaos import ChaosInjector
+from ..resilience.supervisor import restart_delay
+from ..spin_the_wheel import WheelSpinner
+from .slice_plan import SlicePlan
+
+
+class SliceSupervisor:
+    """Per-slice health for the MPMD wheel's controller threads.
+
+    Shares SpokeSupervisor's option names/defaults (the hub's options
+    dict configures either) and its counter attributes
+    (`spoke_restarts` / `spokes_failed`), so resilience.wheel_counters
+    and the bench JSON read both supervisors identically."""
+
+    def __init__(self, hub, spokes, plan, options=None):
+        o = dict(hub.options or {})
+        o.update(options or {})
+        self.hub = hub
+        self.spokes = list(spokes)
+        self.plan = plan
+        self.interval = float(o.get("supervise_interval", 0.25))
+        self.hang_timeout = float(o.get("spoke_hang_timeout", 300.0))
+        self.max_restarts = int(o.get("spoke_max_restarts", 2))
+        self.backoff = float(o.get("spoke_restart_backoff", 0.5))
+        self.backoff_cap = float(o.get("spoke_restart_backoff_cap", 30.0))
+        n = len(self.spokes)
+        self.threads = [None] * n
+        self.restarts = [0] * n
+        self.spoke_restarts = 0
+        self.spokes_failed = 0
+        self.exit_reports = []
+        self._busy = [0.0] * n
+        self._busy_in_hub = [0.0] * n
+        self._last_wid = [None] * n
+        self._last_progress = [None] * n
+        self._last_poll = 0.0
+        self._hung = set()
+        self._shutting_down = False
+        self.hub_t0 = None
+        self.hub_t1 = None
+        self._tel = getattr(hub, "telemetry", None) or _telemetry.get()
+        for i, sp in enumerate(self.spokes):
+            self._wrap_step(sp, i)
+
+    def _wrap_step(self, sp, i):
+        """Instrument the spoke's step with per-slice busy accounting —
+        the raw material of hub_overlap_fraction and the per-slice
+        phase_seconds in the bench JSON."""
+        orig = sp.timed_step
+
+        def timed_step():
+            s = time.monotonic()
+            try:
+                return orig()
+            finally:
+                e = time.monotonic()
+                self._busy[i] += e - s
+                if self.hub_t0 is not None:
+                    lo = max(s, self.hub_t0)
+                    hi = e if self.hub_t1 is None else min(e, self.hub_t1)
+                    if hi > lo:
+                        self._busy_in_hub[i] += hi - lo
+
+        sp.timed_step = timed_step
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        for i in range(len(self.spokes)):
+            self._launch(i)
+        return self
+
+    def _launch(self, i):
+        th = threading.Thread(target=self._guarded_main, args=(i,),
+                              daemon=True, name=f"mpmd-slice{i + 1}")
+        self.threads[i] = th
+        self._tel.event("wheel.slice_spawn", slice=i + 1,
+                        incarnation=self.restarts[i])
+        th.start()
+
+    def _guarded_main(self, i):
+        try:
+            self.spokes[i].main()
+        except Exception as e:
+            self._on_crash(i, e)
+
+    def _on_crash(self, i, exc):
+        sp = self.spokes[i]
+        self.exit_reports.append(
+            {"slice": i + 1, "name": type(sp).__name__,
+             "incarnation": self.restarts[i], "error": str(exc)})
+        if self._shutting_down or sp.got_kill_signal():
+            return                     # the wheel is over; don't relaunch
+        if self.restarts[i] < self.max_restarts:
+            self.restarts[i] += 1
+            self.spoke_restarts += 1
+            delay = restart_delay(self.restarts[i], self.backoff,
+                                  self.backoff_cap)
+            self._tel.event("wheel.slice_restart", slice=i + 1,
+                            reason=str(exc),
+                            incarnation=self.restarts[i], delay=delay)
+            self._tel.counter("wheel.slice_restarts").inc()
+            global_toc(f"WARNING: mpmd slice {i + 1} "
+                       f"({type(sp).__name__}) crashed: {exc}; restart "
+                       f"{self.restarts[i]}/{self.max_restarts} in "
+                       f"{delay:.2f}s")
+            time.sleep(delay)
+            # a restarted incarnation re-runs its fault-injection
+            # schedule from scratch, exactly like a respawned process
+            sp.chaos = ChaosInjector.from_options(
+                sp.options.get("chaos"))
+            self._launch(i)
+        else:
+            self.spokes_failed += 1
+            self._tel.event("wheel.slice_prune", slice=i + 1,
+                            reason=str(exc), restarts=self.restarts[i])
+            self._tel.counter("wheel.slices_failed").inc()
+            self.hub.report_spoke_failure(sp, RuntimeError(
+                f"{exc} after {self.restarts[i]} restart(s)"))
+
+    # -- supervision (hub thread, called from Hub.sync) -------------------
+    def poll(self, force=False):
+        now = time.monotonic()
+        if self._shutting_down or (not force and
+                                   now - self._last_poll < self.interval):
+            return
+        self._last_poll = now
+        for i, sp in enumerate(self.spokes):
+            if getattr(sp, "_failed", False) or sp.pair is None:
+                continue
+            # heartbeat: the slice's to_hub write_id, same liveness
+            # signal the multiproc supervisor uses (bound spokes re-post
+            # on a timer so the id advances even at a fixed bound)
+            wid = sp.pair.to_hub.write_id
+            if wid != self._last_wid[i] or self._last_progress[i] is None:
+                self._last_wid[i] = wid
+                self._last_progress[i] = now
+            age = now - self._last_progress[i]
+            self._tel.gauge(
+                f"wheel.slice_heartbeat_age.slice{i + 1}").set(age)
+            if age > self.hang_timeout and i not in self._hung:
+                th = self.threads[i]
+                if th is not None and th.is_alive():
+                    # a thread cannot be killed: prune the slice so the
+                    # wheel finishes on the live ones
+                    self._hung.add(i)
+                    self.spokes_failed += 1
+                    self._tel.event("wheel.slice_hang", slice=i + 1,
+                                    age=age)
+                    self.hub.report_spoke_failure(sp, RuntimeError(
+                        f"slice hung: no window write for {age:.1f}s"))
+
+    # -- shutdown (after hub.send_terminate) ------------------------------
+    def shutdown(self, timeout=120.0):
+        """Per-thread bounded join (the threaded wheel's policy): a
+        slice still alive past its budget is escalated through the
+        failure-pruning path and its daemon thread dies with the
+        process."""
+        self._shutting_down = True
+        for i, th in enumerate(self.threads):
+            if th is None:
+                continue
+            th.join(timeout=timeout)
+            if th.is_alive():
+                self.hub.report_spoke_failure(self.spokes[i], TimeoutError(
+                    f"slice did not exit within {timeout:.0f}s of the "
+                    "kill signal"))
+
+    # -- accounting -------------------------------------------------------
+    def overlap_fraction(self):
+        """Fraction of the hub's main() wall-clock covered by spoke
+        work on other slices (summed over slices, capped at 1.0 — with
+        several spokes the raw sum can exceed the hub window, which
+        just means more than one slice was busy at once)."""
+        if self.hub_t0 is None or self.hub_t1 is None:
+            return 0.0
+        dur = self.hub_t1 - self.hub_t0
+        if dur <= 0.0:
+            return 0.0
+        return min(1.0, sum(self._busy_in_hub) / dur)
+
+    def phase_seconds(self):
+        """Per-slice busy seconds keyed by trace track ("hub" is filled
+        in by the wheel)."""
+        return {(sp.telemetry_track or f"slice{i + 1}"):
+                round(self._busy[i], 6)
+                for i, sp in enumerate(self.spokes)}
+
+    def health(self):
+        return [{"slice": i + 1, "name": type(sp).__name__,
+                 "alive": bool(self.threads[i] is not None
+                               and self.threads[i].is_alive()),
+                 "failed": bool(getattr(sp, "_failed", False)),
+                 "restarts": self.restarts[i],
+                 "busy_seconds": round(self._busy[i], 4),
+                 "devices": [str(d) for d in
+                             self.plan.slices[i + 1].devices]}
+                for i, sp in enumerate(self.spokes)]
+
+
+class MPMDWheel(WheelSpinner):
+    """WheelSpinner whose cylinders run on disjoint mesh slices with
+    device-resident exchange.
+
+    lockstep=True drives spokes inline from the hub's sync (the
+    deterministic interleaved schedule, for parity runs); the default
+    overlaps spoke controller threads with the hub's supersteps."""
+
+    def __init__(self, hub_dict, list_of_spoke_dict=(), plan=None,
+                 spoke_devices=1, lockstep=False, keep_workdir=False,
+                 resume_from=None):
+        super().__init__(hub_dict, list_of_spoke_dict, mode="mpmd",
+                         keep_workdir=keep_workdir,
+                         resume_from=resume_from)
+        self.plan = plan
+        self.spoke_devices = spoke_devices
+        self.lockstep = lockstep
+        self.supervisor = None
+        self.hub_main_seconds = 0.0
+        self.hub_overlap_fraction = 0.0
+        self.slice_phase_seconds = {}
+
+    def spin(self):
+        import jax
+
+        from ..ir import pad_scenarios
+
+        hd = self.hub_dict
+        plan = self.plan
+        if plan is None:
+            plan = SlicePlan.partition(len(self.list_of_spoke_dict),
+                                       devices=jax.devices(),
+                                       spoke_devices=self.spoke_devices)
+        self.plan = plan
+        global_toc(f"MPMDWheel: {plan.n_slices} slices over "
+                   f"{len(plan.devices)} devices (hub: "
+                   f"{plan.hub.n_devices})")
+
+        hub_kw = dict(hd["opt_kwargs"])
+        batch = hub_kw.get("batch")
+        if batch is None:
+            raise RuntimeError(
+                "MPMDWheel needs opt_kwargs['batch']: every cylinder "
+                "lowers the one shared host batch onto its own slice")
+        q = plan.pad_multiple()
+        Spad = ((batch.num_scens + q - 1) // q) * q
+        batch = pad_scenarios(batch, Spad)
+        hub_kw["batch"] = batch
+        hub_kw["mesh"] = plan.hub.mesh()
+        global_toc("MPMDWheel: constructing hub optimizer on its slice")
+        hub_opt = hd["opt_class"](**hub_kw)
+
+        spokes = []
+        for j, sd in enumerate(self.list_of_spoke_dict):
+            kw = dict(sd["opt_kwargs"])
+            kw["batch"] = batch        # same host batch, own sharding
+            kw["mesh"] = plan.spokes[j].mesh()
+            sp_opt = sd["opt_class"](**kw)
+            spoke = sd["spoke_class"](
+                sp_opt, options=sd.get("spoke_kwargs", {}).get("options"))
+            spoke.telemetry_track = (
+                f"slice{j + 1}:{type(spoke).__name__}")
+            spokes.append(spoke)
+
+        hub_options = dict(hd.get("hub_kwargs", {}).get("options") or {})
+        hub_options.setdefault("window_backend", "device")
+        # each pair's mailboxes pin to the receiving slice's first
+        # device (device_window_pair)
+        hub_options["window_backend_kwargs"] = {
+            j: {"spoke_device": plan.spokes[j].devices[0],
+                "hub_device": plan.hub.devices[0],
+                "tag": f"pair{j}"}
+            for j in range(len(spokes))}
+        hub = hd["hub_class"](hub_opt, spokes, options=hub_options)
+        hub.setup_hub()
+        self._restore_hub_bounds(hub)
+        self.spcomm = hub
+        hub.telemetry.gauge("wheel.n_slices").set(plan.n_slices)
+
+        sup = SliceSupervisor(hub, spokes, plan)
+        hub.supervisor = sup
+        self.supervisor = sup
+
+        if self.lockstep or not spokes:
+            hub.drive_spokes_inline = True
+            t0 = time.monotonic()
+            hub.main()
+            self.hub_main_seconds = time.monotonic() - t0
+            hub.send_terminate()
+        else:
+            hub.drive_spokes_inline = False
+            sup.start()
+            sup.hub_t0 = time.monotonic()
+            hub.main()
+            sup.hub_t1 = time.monotonic()
+            self.hub_main_seconds = sup.hub_t1 - sup.hub_t0
+            sup.poll(force=True)
+            hub.send_terminate()
+            sup.shutdown(timeout=float(hub.options.get(
+                "shutdown_join_timeout", 120.0)))
+            hub._drain_failures()
+
+        for sp in spokes:
+            if getattr(sp, "_failed", False):
+                continue
+            try:
+                sp.finalize()
+            except Exception as e:  # a failing final pass must not eat
+                global_toc(f"spoke finalize failed: {e}")  # the results
+        hub.hub_finalize()
+        self.hub_overlap_fraction = sup.overlap_fraction()
+        self.slice_phase_seconds = dict(
+            {"hub": round(self.hub_main_seconds, 6)},
+            **sup.phase_seconds())
+        self._flush_telemetry()
+        self._ran = True
+        return self

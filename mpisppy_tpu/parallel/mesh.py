@@ -42,12 +42,28 @@ class ScenarioMesh:
     cross-process collectives over DCN, the analog of the reference's
     inter-node MPI traffic (SURVEY.md §2.3)."""
 
-    def __init__(self, devices=None, axis_name="scen"):
+    def __init__(self, devices=None, axis_name="scen", n_cyl=None,
+                 cyl_axis="cyl"):
         if devices is None:
             devices = jax.devices()
         self.devices = list(devices)
         self.axis_name = axis_name
-        self.mesh = Mesh(np.array(self.devices), (axis_name,))
+        self.n_cyl = int(n_cyl) if n_cyl else None
+        self.cyl_axis = cyl_axis if self.n_cyl else None
+        if self.n_cyl:
+            # 2-D cylinder x scenario grid: one row per cylinder, the
+            # scenario axis within each row (the reference's rank grid,
+            # spin_the_wheel.py:219-237 _make_comms).  Batches shard on
+            # the scenario axis only, so each cylinder row holds a full
+            # scenario-sharded copy
+            if len(self.devices) % self.n_cyl:
+                raise ValueError(
+                    f"{len(self.devices)} devices do not split into "
+                    f"{self.n_cyl} cylinder rows")
+            grid = np.array(self.devices).reshape(self.n_cyl, -1)
+            self.mesh = Mesh(grid, (cyl_axis, axis_name))
+        else:
+            self.mesh = Mesh(np.array(self.devices), (axis_name,))
         # single-process fast path keeps plain device_put
         self.multihost = jax.process_count() > 1
 
@@ -62,6 +78,43 @@ class ScenarioMesh:
     def size(self):
         return len(self.devices)
 
+    @property
+    def scen_size(self):
+        """Extent of the scenario axis — the padding quantum for
+        shard_batch.  Equals `size` on a 1-D mesh; on a 2-D cylinder x
+        scenario mesh each cylinder row holds `size // n_cyl` scenario
+        shards."""
+        return self.size // self.n_cyl if self.n_cyl else self.size
+
+    def submesh(self, devices, axis_name=None):
+        """A fresh 1-D ScenarioMesh over a subset of this mesh's
+        devices — the building block of mpmd.SlicePlan (each cylinder
+        gets its own disjoint submesh)."""
+        devs = list(devices)
+        if not devs:
+            raise ValueError("submesh needs at least one device")
+        missing = [d for d in devs if d not in self.devices]
+        if missing:
+            raise ValueError(
+                f"devices {missing} are not part of this mesh")
+        return ScenarioMesh(devs, axis_name=axis_name or self.axis_name)
+
+    def slice_axis(self, axis=None):
+        """Split the cylinder axis of a 2-D mesh into one 1-D
+        ScenarioMesh per cylinder row.  The returned submeshes are
+        pairwise disjoint and together cover this mesh's device list
+        (guarded by tests/test_mpmd_wheel.py).  A 1-D mesh is its own
+        single slice."""
+        if axis is not None and self.cyl_axis is not None \
+                and axis != self.cyl_axis:
+            raise ValueError(
+                f"mesh has cylinder axis {self.cyl_axis!r}, not {axis!r}")
+        if not self.n_cyl:
+            return [self]
+        per_row = len(self.devices) // self.n_cyl
+        return [self.submesh(self.devices[r * per_row:(r + 1) * per_row])
+                for r in range(self.n_cyl)]
+
     def batch_sharding(self):
         """Sharding for (S, ...) scenario-leading arrays."""
         return NamedSharding(self.mesh, P(self.axis_name))
@@ -75,7 +128,7 @@ class ScenarioMesh:
         sputils.py:804-812) and place each leaf: scenario-leading arrays
         sharded on "scen", shared metadata replicated."""
         S = batch.num_scens
-        n = self.size
+        n = self.scen_size
         Spad = ((S + n - 1) // n) * n
         batch = pad_scenarios(batch, Spad)
         shard = self.batch_sharding()

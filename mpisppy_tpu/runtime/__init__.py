@@ -1,1 +1,1 @@
-from .native import NativeWindow, available  # noqa: F401
+from .native import NativeWindow, PySeqlockWindow, available  # noqa: F401

@@ -30,11 +30,17 @@ from . import global_toc
 
 class WheelSpinner:
     def __init__(self, hub_dict, list_of_spoke_dict=(), mode="interleaved",
-                 keep_workdir=False, resume_from=None):
+                 keep_workdir=False, resume_from=None,
+                 exchange_backend=None):
         self._validate(hub_dict, list_of_spoke_dict)
         self.hub_dict = hub_dict
         self.list_of_spoke_dict = list(list_of_spoke_dict)
         self.mode = mode
+        # exchange seam: None/"auto" picks by device count ("device"
+        # mailboxes on a multi-device fleet, host seqlock on one
+        # device); "seqlock"/"native"/"device" force a backend.  An
+        # explicit window_backend in the hub options always wins.
+        self.exchange_backend = exchange_backend
         self.spcomm = None
         self._ran = False
         # multiproc mode: keep the window/log tempdir for debugging
@@ -50,6 +56,31 @@ class WheelSpinner:
             kw["options"] = dict(kw.get("options") or {},
                                  resume_from=resume_from)
             self.hub_dict = dict(self.hub_dict, opt_kwargs=kw)
+
+    def _select_backend(self, hub_opt):
+        """Resolve the exchange backend for the in-process modes.
+        "auto" (the default) selects the device-resident mailboxes
+        (mpmd/exchange.py) whenever the hub's mesh spans more than one
+        device, and the host seqlock on a single device — so existing
+        single-device runs are bit-identical and multi-device runs keep
+        the exchange on-device.  Multiproc mode never lands here (it is
+        always the native mmap seqlock: device buffers cannot cross a
+        process boundary)."""
+        req = self.exchange_backend or "auto"
+        if req in ("seqlock", "python"):
+            return "python"
+        if req == "native":
+            return "native"
+        n = getattr(getattr(hub_opt, "mesh", None), "size", 1)
+        if req == "device" or (req == "auto" and n > 1):
+            try:
+                from . import mpmd  # noqa: F401 — registers "device"
+                return "device"
+            except Exception as e:  # pragma: no cover - degraded env
+                global_toc(f"WheelSpinner: device exchange unavailable "
+                           f"({e}); using the host seqlock")
+                return "python"
+        return "python"
 
     def _restore_hub_bounds(self, hub):
         from .resilience.checkpoint import checkpoint_exists, restore_hub
@@ -107,9 +138,10 @@ class WheelSpinner:
                 f"spoke{len(spokes)}:{type(spoke).__name__}")
             spokes.append(spoke)
 
-        hub = hd["hub_class"](
-            hub_opt, spokes,
-            options=hd.get("hub_kwargs", {}).get("options"))
+        hub_options = dict(hd.get("hub_kwargs", {}).get("options") or {})
+        hub_options.setdefault(
+            "window_backend", self._select_backend(hub_opt))
+        hub = hd["hub_class"](hub_opt, spokes, options=hub_options)
         hub.setup_hub()
         self._restore_hub_bounds(hub)
         self.spcomm = hub

@@ -246,6 +246,34 @@ def stream_counters(registry=None):
     return out
 
 
+def wheel_counters(registry=None):
+    """MPMD-wheel exchange/supervision counters for bench JSON (zeros
+    when the run had telemetry off — keys are stable either way).
+    Distinct from resilience.wheel_counters (which reads a hub's
+    supervisor attributes): this reads the wheel.* instruments — the
+    device-exchange traffic (bytes/writes/latency), window-level stale
+    reads, slice restart/prune counts, the slice-count gauge, and the
+    per-slice bound-progression gauges keyed by trace track."""
+    reg = registry if registry is not None else get().registry
+    names = ("wheel.exchange_writes", "wheel.exchange_bytes",
+             "wheel.stale_reads", "wheel.slice_restarts",
+             "wheel.slices_failed")
+    vals = ({k: c.value for k, c in reg._counters.items()}
+            if reg.enabled else {})
+    out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
+    g = reg._gauges.get("wheel.n_slices") if reg.enabled else None
+    out["wheel_n_slices"] = int(g.value) if g is not None else 0
+    h = (reg._histograms.get("wheel.exchange_seconds")
+         if reg.enabled else None)
+    out["wheel_exchange_latency_seconds"] = (
+        float(h.total) if h is not None else 0.0)
+    out["wheel_slice_bounds"] = (
+        {k[len("wheel.slice_bound."):]: float(g.value)
+         for k, g in reg._gauges.items()
+         if k.startswith("wheel.slice_bound.")} if reg.enabled else {})
+    return out
+
+
 def serve_counters(registry=None):
     """Serve-layer counter dict for bench JSON (zeros when the run had
     telemetry off — keys are stable either way)."""

@@ -52,3 +52,8 @@ def pytest_configure(config):
         "(ScenarioSource blocks, double-buffered stream, adaptive "
         "sampler, StreamingPH parity/checkpoint); these RUN under "
         "tier-1's `-m 'not slow'`")
+    config.addinivalue_line(
+        "markers", "mpmd: device-resident MPMD wheel tests (slice "
+        "plans, device mailboxes, seqlock parity, slice supervision) "
+        "on the faked 8-device fleet; these RUN under tier-1's "
+        "`-m 'not slow'`")

@@ -1,0 +1,570 @@
+"""MPMD-wheel tests: slice plans over the faked 8-device fleet,
+device-resident mailboxes vs. the host seqlock, the exchange-backend
+seam, crash/prune parity with the multiproc supervisor, and the
+import-layering guards (cylinders/ never imports mpmd/; mpmd/ keeps
+jax lazy).
+
+Everything runs on the 8 virtual CPU devices conftest.py forces with
+--xla_force_host_platform_device_count, so the cross-slice device_put
+hops are real resharding transfers, just over host memory.
+"""
+
+import ast
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from efcheck import ef_linprog
+from mpisppy_tpu import telemetry
+from mpisppy_tpu.cylinders.hub import PHHub
+from mpisppy_tpu.cylinders.lagrangian_bounder import LagrangianOuterBound
+from mpisppy_tpu.cylinders.spcommunicator import (
+    _WINDOW_BACKENDS, Window, WindowPair)
+from mpisppy_tpu.cylinders.xhatshufflelooper_bounder import (
+    XhatShuffleInnerBound)
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.mpmd import (
+    CylinderSlice, DeviceWindow, MPMDWheel, SlicePlan)
+from mpisppy_tpu.opt.ph import PH
+from mpisppy_tpu.parallel.mesh import ScenarioMesh
+from mpisppy_tpu.runtime import native
+from mpisppy_tpu.spin_the_wheel import WheelSpinner
+from mpisppy_tpu.utils.xhat_eval import Xhat_Eval
+
+pytestmark = pytest.mark.mpmd
+
+PKG_ROOT = os.path.join(os.path.dirname(__file__), "..", "mpisppy_tpu")
+
+OPTS = {"defaultPHrho": 1.0, "PHIterLimit": 40, "convthresh": 0.0,
+        "pdhg_eps": 1e-7, "pdhg_max_iters": 20000}
+S = 3
+NAMES = [f"scen{i}" for i in range(S)]
+
+
+def farmer_dicts(hub_class=PHHub, spoke_chaos=None, opt_overrides=None,
+                 hub_opts=None):
+    """hub+Lagrangian+xhat wheel dicts on farmer S=3 (the
+    test_resilience.farmer_wheel shapes, separated so both WheelSpinner
+    and MPMDWheel can consume them)."""
+    opts = {**OPTS, **(opt_overrides or {})}
+    lag_opts = {"chaos": spoke_chaos} if spoke_chaos else {}
+    hub_dict = {
+        "hub_class": hub_class,
+        "hub_kwargs": {"options": {"rel_gap": 1e-4, "abs_gap": 1.0,
+                                   **(hub_opts or {})}},
+        "opt_class": PH,
+        "opt_kwargs": {"options": opts, "all_scenario_names": NAMES,
+                       "batch": farmer.build_batch(S)},
+    }
+    spoke_dicts = [
+        {"spoke_class": LagrangianOuterBound,
+         "spoke_kwargs": {"options": lag_opts},
+         "opt_class": PH,
+         "opt_kwargs": {"options": dict(opts),
+                        "all_scenario_names": NAMES}},
+        {"spoke_class": XhatShuffleInnerBound,
+         "spoke_kwargs": {"options": {}},
+         "opt_class": Xhat_Eval,
+         "opt_kwargs": {"options": dict(opts),
+                        "all_scenario_names": NAMES}},
+    ]
+    return hub_dict, spoke_dicts
+
+
+@pytest.fixture
+def fresh_telemetry():
+    """Enabled telemetry with a fresh registry, dropped after the test
+    so later tests see the default (env-driven, disabled) instance."""
+    tel = telemetry.configure(True)
+    yield tel
+    telemetry.reset()
+
+
+class TestMesh2D:
+    """Satellite: the 2-D cylinder x scenario ScenarioMesh."""
+
+    def test_2d_shape_and_scen_size(self):
+        m = ScenarioMesh(n_cyl=4)
+        assert m.size == 8
+        assert m.scen_size == 2
+        assert m.mesh.axis_names == ("cyl", "scen")
+        with pytest.raises(ValueError, match="do not split"):
+            ScenarioMesh(devices=jax.devices()[:6], n_cyl=4)
+
+    def test_slice_axis_disjoint_and_cover(self):
+        m = ScenarioMesh(n_cyl=4)
+        rows = m.slice_axis("cyl")
+        assert len(rows) == 4
+        seen = []
+        for sub in rows:
+            assert isinstance(sub, ScenarioMesh)
+            assert sub.n_cyl is None          # rows are 1-D
+            assert sub.size == 2
+            for d in sub.devices:
+                assert d not in seen           # pairwise disjoint
+                seen.append(d)
+        assert seen == m.devices               # together they cover
+
+    def test_slice_axis_names_and_1d(self):
+        m2 = ScenarioMesh(n_cyl=2)
+        with pytest.raises(ValueError, match="cylinder axis"):
+            m2.slice_axis("rows")
+        m1 = ScenarioMesh()
+        assert m1.slice_axis() == [m1]
+
+    def test_submesh_membership(self):
+        m = ScenarioMesh(devices=jax.devices()[:4])
+        sub = m.submesh(jax.devices()[1:3])
+        assert sub.devices == jax.devices()[1:3]
+        with pytest.raises(ValueError, match="not part of this mesh"):
+            m.submesh([jax.devices()[5]])
+        with pytest.raises(ValueError, match="at least one device"):
+            m.submesh([])
+
+    def test_2d_rows_shard_batch_identically(self):
+        """Each cylinder row pads to its own scen_size — equal rows
+        mean every cylinder agrees on the padded S (the window-length
+        invariant the MPMD wheel needs)."""
+        m = ScenarioMesh(n_cyl=2)
+        b = farmer.build_batch(3)
+        sharded = m.shard_batch(b)
+        assert sharded.num_scens == 4          # padded to scen_size=4
+        for sub in m.slice_axis():
+            assert sub.shard_batch(b).num_scens == 4
+
+
+class TestSlicePlan:
+    def test_partition_hub_heavy(self):
+        plan = SlicePlan.partition(2, devices=jax.devices())
+        assert plan.n_slices == 3
+        assert plan.hub.name == "hub" and plan.hub.n_devices == 6
+        assert [s.n_devices for s in plan.spokes] == [1, 1]
+        assert plan.pad_multiple() == 6        # lcm(6, 1, 1)
+        assert plan.devices == jax.devices()
+        # slice meshes are real ScenarioMeshes over their devices
+        assert plan.hub.mesh().size == 6
+
+    def test_disjointness_enforced(self):
+        d = jax.devices()
+        with pytest.raises(ValueError, match="disjoint"):
+            SlicePlan([CylinderSlice("hub", 0, (d[0], d[1])),
+                       CylinderSlice("spoke0", 1, (d[1],))])
+        with pytest.raises(ValueError, match="no devices"):
+            SlicePlan([CylinderSlice("hub", 0, ())])
+        with pytest.raises(ValueError, match="at least the hub"):
+            SlicePlan([])
+
+    def test_partition_too_few_devices(self):
+        with pytest.raises(ValueError, match="need at least"):
+            SlicePlan.partition(2, devices=jax.devices()[:2])
+
+    def test_uniform_from_2d_mesh(self):
+        m = ScenarioMesh(n_cyl=4)
+        plan = SlicePlan.uniform(m, spoke_names=["lag", "xhat", "cut"])
+        assert [s.name for s in plan.slices] == \
+            ["hub", "lag", "xhat", "cut"]
+        assert all(s.n_devices == 2 for s in plan.slices)
+        assert plan.pad_multiple() == 2
+        with pytest.raises(ValueError, match="n_cyl >= 2"):
+            SlicePlan.uniform(ScenarioMesh())
+
+    def test_from_mesh_validates_membership(self):
+        m = ScenarioMesh(devices=jax.devices()[:4])
+        plan = SlicePlan.from_mesh(m, 2)
+        assert plan.hub.n_devices == 2
+        with pytest.raises(ValueError, match="need at least"):
+            SlicePlan.from_mesh(ScenarioMesh(devices=jax.devices()[:2]), 2)
+
+    def test_describe_json_safe(self):
+        import json
+        plan = SlicePlan.partition(2, devices=jax.devices())
+        desc = json.loads(json.dumps(plan.describe()))
+        assert desc[0]["name"] == "hub" and len(desc) == 3
+
+
+class TestDeviceWindow:
+    def test_roundtrip_and_ids(self):
+        w = DeviceWindow(4)
+        data, wid = w.read()
+        assert wid == 0 and np.array_equal(data, np.zeros(4))
+        assert w.write(np.arange(4.0)) == 1
+        data, wid = w.read()
+        assert wid == 1 and np.array_equal(data, np.arange(4.0))
+        assert data.dtype == np.float64
+        # explicit id (the spoke-side heartbeat protocol re-posts
+        # under a chosen id)
+        assert w.write(np.ones(4), write_id=7) == 7
+        assert w.write_id == 7
+
+    def test_shape_mismatch(self):
+        w = DeviceWindow(4)
+        with pytest.raises(ValueError, match="expects shape"):
+            w.write(np.zeros(3))
+
+    def test_kill_signal(self):
+        w = DeviceWindow(2)
+        w.write(np.ones(2))
+        w.send_kill()
+        assert w.write_id == Window.KILL == DeviceWindow.KILL
+        _, wid = w.read()
+        assert wid == -1
+
+    def test_payload_lives_on_the_pinned_device(self):
+        target = jax.devices()[5]
+        w = DeviceWindow(3, device=target)
+        w.write(np.arange(3.0))
+        arr, wid = w.read_device()
+        assert wid == 1
+        assert list(arr.devices()) == [target]
+        # read_device hands back the committed device array, no host copy
+        assert isinstance(arr, jax.Array)
+        np.testing.assert_array_equal(np.asarray(arr), np.arange(3.0))
+
+    def test_stale_read_accounting(self, fresh_telemetry):
+        w = DeviceWindow(2)
+        reg = fresh_telemetry.registry
+        w.write(np.ones(2))
+        w.read()
+        assert reg.counter("wheel.stale_reads").value == 0
+        w.read()                               # same id again -> stale
+        assert reg.counter("wheel.stale_reads").value == 1
+        w.write(np.zeros(2))
+        w.read()                               # fresh id -> not stale
+        assert reg.counter("wheel.stale_reads").value == 1
+        # pre-first-write id 0 and the kill id never count as stale
+        w.send_kill()
+        w.read()
+        w.read()
+        assert reg.counter("wheel.stale_reads").value == 1
+        assert reg.counter("wheel.exchange_writes").value == 2
+        assert reg.counter("wheel.exchange_bytes").value == 32
+        assert reg.histogram("wheel.exchange_seconds").total > 0.0
+
+
+class TestPySeqlockFallback:
+    """Satellite: the pure-Python mmap seqlock behind NativeWindow."""
+
+    def test_roundtrip_ids_kill(self):
+        w = native.PySeqlockWindow(3)
+        data, wid = w.read()
+        assert wid == 0 and np.array_equal(data, np.zeros(3))
+        assert w.write(np.arange(3.0)) == 1
+        assert w.write(np.arange(3.0) + 1, write_id=9) == 9
+        data, wid = w.read()
+        assert wid == 9 and np.array_equal(data, np.arange(3.0) + 1)
+        with pytest.raises(ValueError, match="expects shape"):
+            w.write(np.zeros(4))
+        w.send_kill()
+        assert w.write_id == -1
+        w.close()
+        w.close()                               # idempotent
+
+    def test_file_backed_cross_handle(self, tmp_path):
+        p = str(tmp_path / "win.to_hub")
+        a = native.PySeqlockWindow(4, path=p)
+        b = native.PySeqlockWindow(4, path=p)   # attach, not reset
+        a.write(np.full(4, 2.5))
+        data, wid = b.read()
+        assert wid == 1 and np.array_equal(data, np.full(4, 2.5))
+        with pytest.raises(RuntimeError, match="length mismatch"):
+            native.PySeqlockWindow(5, path=p)
+        a.close()
+        b.close()
+
+    def test_native_window_delegates_when_lib_missing(self, monkeypatch):
+        monkeypatch.setattr(native, "_load", lambda: None)
+        assert not native.available()
+        w = native.NativeWindow(3)
+        assert w._py is not None                # pure-Python inside
+        w.write(np.arange(3.0))
+        data, wid = w.read()
+        assert wid == 1 and np.array_equal(data, np.arange(3.0))
+        w.send_kill()
+        assert w.write_id == -1
+        w.close()
+
+    @pytest.mark.skipif(not native.available(),
+                        reason="compiled exchange library unavailable")
+    def test_interop_with_native_layout(self, tmp_path):
+        """One mmap file, C++ writer + Python reader and vice versa —
+        the fallback really is the same memory layout."""
+        p = str(tmp_path / "interop")
+        cpp = native.NativeWindow(3, path=p, reset=True)
+        py = native.PySeqlockWindow(3, path=p)
+        cpp.write(np.array([1.0, 2.0, 3.0]))
+        data, wid = py.read()
+        assert wid == 1 and np.array_equal(data, [1.0, 2.0, 3.0])
+        py.write(np.array([4.0, 5.0, 6.0]))
+        data, wid = cpp.read()
+        assert wid == 2 and np.array_equal(data, [4.0, 5.0, 6.0])
+        py.send_kill()
+        assert cpp.write_id == -1
+        cpp.close()
+        py.close()
+
+
+class TestBackendSeam:
+    def test_registry_has_device_backend(self):
+        assert "device" in _WINDOW_BACKENDS   # mpmd imported above
+        pair = WindowPair(4, 2, backend="device")
+        assert isinstance(pair.to_spoke, DeviceWindow)
+        assert pair.to_spoke.length == 4 and pair.to_hub.length == 2
+
+    def test_backend_kwargs_flow_through(self):
+        d = jax.devices()
+        pair = WindowPair(4, 2, backend="device",
+                          backend_kwargs={"spoke_device": d[6],
+                                          "hub_device": d[0],
+                                          "tag": "pair0"})
+        pair.to_spoke.write(np.zeros(4))
+        pair.to_hub.write(np.zeros(2))
+        # each mailbox sits on the RECEIVING slice
+        assert list(pair.to_spoke.read_device()[0].devices()) == [d[6]]
+        assert list(pair.to_hub.read_device()[0].devices()) == [d[0]]
+
+    def test_unregistered_backend_raises(self):
+        with pytest.raises(RuntimeError, match="not registered"):
+            WindowPair(4, 2, backend="bogus")
+
+    def test_seqlock_alias(self):
+        pair = WindowPair(4, 2, backend="seqlock")
+        assert type(pair.to_spoke) is Window
+        assert type(pair.to_hub) is Window
+
+    def test_select_backend(self):
+        hub_dict, spoke_dicts = farmer_dicts()
+
+        class FakeOpt:
+            def __init__(self, n):
+                self.mesh = type("M", (), {"size": n})()
+
+        ws = WheelSpinner(hub_dict, spoke_dicts)
+        assert ws._select_backend(FakeOpt(8)) == "device"   # auto, fleet
+        assert ws.exchange_backend is None
+        assert ws._select_backend(FakeOpt(1)) == "python"   # auto, solo
+        ws = WheelSpinner(hub_dict, spoke_dicts,
+                          exchange_backend="seqlock")
+        assert ws._select_backend(FakeOpt(8)) == "python"   # forced host
+        ws = WheelSpinner(hub_dict, spoke_dicts,
+                          exchange_backend="native")
+        assert ws._select_backend(FakeOpt(8)) == "native"
+        ws = WheelSpinner(hub_dict, spoke_dicts,
+                          exchange_backend="device")
+        assert ws._select_backend(FakeOpt(1)) == "device"   # forced device
+
+
+class RecordingHub(PHHub):
+    """PHHub that logs (BestOuterBound, BestInnerBound) after every
+    sync — the bound trajectory the parity test compares."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.bound_trace = []
+
+    def sync(self):
+        super().sync()
+        self.bound_trace.append((float(self.BestOuterBound),
+                                 float(self.BestInnerBound)))
+
+
+class TestExchangeParity:
+    def test_device_vs_seqlock_bound_trajectory(self):
+        """The exchange backend is pure transport: the interleaved
+        wheel's per-iteration bound trajectory on farmer must be
+        IDENTICAL through host seqlock windows and device mailboxes
+        (both carry the same float64 vectors; the schedule is the
+        deterministic inline one)."""
+        traces = {}
+        for backend in ("seqlock", "device"):
+            hub_dict, spoke_dicts = farmer_dicts(hub_class=RecordingHub)
+            ws = WheelSpinner(hub_dict, spoke_dicts, mode="interleaved",
+                              exchange_backend=backend)
+            ws.spin()
+            assert ws.spcomm.options["window_backend"] == \
+                ("python" if backend == "seqlock" else "device")
+            traces[backend] = np.array(ws.spcomm.bound_trace)
+        a, b = traces["seqlock"], traces["device"]
+        assert a.shape == b.shape and len(a) > 0
+        np.testing.assert_allclose(a, b, rtol=1e-9)
+        # same certified verdict, and the run actually produced bounds
+        assert np.isfinite(a[-1]).all()
+
+
+class TestMPMDWheelEndToEnd:
+    def test_overlapped_wheel_brackets_ef(self, fresh_telemetry):
+        hub_dict, spoke_dicts = farmer_dicts(
+            opt_overrides={"telemetry": True})
+        ws = MPMDWheel(hub_dict, spoke_dicts)
+        ws.spin()
+        # disjoint 3-slice plan over the faked fleet
+        plan = ws.plan
+        assert plan.n_slices == 3
+        assert len(set(plan.devices)) == sum(
+            s.n_devices for s in plan.slices)
+        # bounds bracket the true EF optimum (minimization)
+        opt_val = ef_linprog(farmer.build_batch(S))[0]
+        assert ws.BestOuterBound <= opt_val + 1.0
+        assert ws.BestInnerBound >= opt_val - 1.0
+        assert ws.BestInnerBound - ws.BestOuterBound < 500.0
+        # accounting the bench JSON reads
+        assert 0.0 <= ws.hub_overlap_fraction <= 1.0
+        keys = set(ws.slice_phase_seconds)
+        assert "hub" in keys
+        assert any(k.startswith("slice1:") for k in keys)
+        assert any(k.startswith("slice2:") for k in keys)
+        c = telemetry.wheel_counters()
+        assert c["wheel_n_slices"] == 3
+        assert c["wheel_exchange_writes"] > 0
+        assert c["wheel_exchange_bytes"] > 0
+        assert c["wheel_exchange_latency_seconds"] > 0.0
+        assert c["wheel_slice_restarts"] == 0
+        assert c["wheel_slices_failed"] == 0
+        # per-slice bound progression gauges (keyed by trace track)
+        tracks = set(c["wheel_slice_bounds"])
+        assert any("LagrangianOuterBound" in t for t in tracks)
+        assert any("XhatShuffleInnerBound" in t for t in tracks)
+        # supervisor health covers both spoke slices, nothing failed
+        health = ws.supervisor.health()
+        assert len(health) == 2
+        assert not any(h["failed"] for h in health)
+
+    def test_lockstep_matches_plan_padding(self, fresh_telemetry):
+        """lockstep drives spokes inline on their own slices; the one
+        shared batch is pre-padded to the plan's lcm so every slice
+        agrees on S (window lengths line up — the run would deadlock
+        on a mismatch)."""
+        hub_dict, spoke_dicts = farmer_dicts(
+            opt_overrides={"telemetry": True})
+        ws = MPMDWheel(hub_dict, spoke_dicts, lockstep=True)
+        ws.spin()
+        assert ws.spcomm.opt.batch.num_scens % ws.plan.pad_multiple() == 0
+        assert np.isfinite(ws.BestOuterBound)
+        assert np.isfinite(ws.BestInnerBound)
+        assert ws.hub_overlap_fraction == 0.0   # nothing overlaps
+
+    def test_missing_batch_rejected(self):
+        hub_dict, spoke_dicts = farmer_dicts()
+        hub_dict = dict(hub_dict,
+                        opt_kwargs={k: v
+                                    for k, v in
+                                    hub_dict["opt_kwargs"].items()
+                                    if k != "batch"})
+        with pytest.raises(RuntimeError, match="opt_kwargs\\['batch'\\]"):
+            MPMDWheel(hub_dict, spoke_dicts).spin()
+
+
+@pytest.mark.chaos
+class TestSliceSupervision:
+    def test_crash_restart_then_prune_parity(self, fresh_telemetry):
+        """An injected crash in the Lagrangian slice restarts the
+        slice thread (fresh chaos schedule, like a respawned process),
+        crashes again, exhausts the budget, and prunes through the
+        SAME report_spoke_failure path the threaded/multiproc wheels
+        use (test_resilience.py parity) — while the xhat slice and the
+        hub still finish the run."""
+        hub_dict, spoke_dicts = farmer_dicts(
+            spoke_chaos={"crash_at_step": 1},
+            opt_overrides={"telemetry": True},
+            hub_opts={"spoke_max_restarts": 1,
+                      "spoke_restart_backoff": 0.01,
+                      "spoke_restart_backoff_cap": 0.02,
+                      "supervise_interval": 0.01})
+        ws = MPMDWheel(hub_dict, spoke_dicts)
+        ws.spin()
+        sup = ws.supervisor
+        assert sup.spoke_restarts == 1
+        assert sup.spokes_failed == 1
+        # both incarnations reported their exits
+        assert [r["incarnation"] for r in sup.exit_reports] == [0, 1]
+        assert all("injected spoke crash" in r["error"]
+                   for r in sup.exit_reports)
+        hub = ws.spcomm
+        assert len(hub.failed_spokes) == 1
+        name, msg = hub.failed_spokes[0]
+        assert name == "LagrangianOuterBound"
+        assert "injected spoke crash" in msg and "1 restart" in msg
+        # the healthy inner slice still closed the wheel
+        assert np.isfinite(ws.BestInnerBound)
+        c = telemetry.wheel_counters()
+        assert c["wheel_slice_restarts"] == 1
+        assert c["wheel_slices_failed"] == 1
+
+
+def _top_level_import_roots(path):
+    """Root module name of every TOP-LEVEL import statement (the
+    test_streaming.py laziness-guard idiom): body-level only, so
+    function-local lazy imports stay allowed."""
+    tree = ast.parse(open(path).read())
+    roots = []
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            roots += [a.name.split(".")[0] for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            if node.module:
+                roots.append(node.module.split(".")[0])
+            else:                     # "from . import x" — the names
+                roots += [a.name.split(".")[0] for a in node.names]
+    return roots
+
+
+class TestImportLayering:
+    """Satellite: the dependency direction is cylinders <- mpmd (via
+    the backend registry), never cylinders -> mpmd; and mpmd itself
+    must not touch jax (or the jax-importing ir/parallel layers) at
+    import time."""
+
+    def test_cylinders_never_import_mpmd(self):
+        cyl_dir = os.path.join(PKG_ROOT, "cylinders")
+        for fn in sorted(os.listdir(cyl_dir)):
+            if not fn.endswith(".py"):
+                continue
+            tree = ast.parse(open(os.path.join(cyl_dir, fn)).read())
+            for node in ast.walk(tree):   # ANY import, even lazy ones
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        assert "mpmd" not in a.name.split("."), \
+                            f"cylinders/{fn} imports mpmd"
+                elif isinstance(node, ast.ImportFrom):
+                    mod = node.module or ""
+                    assert "mpmd" not in mod.split("."), \
+                        f"cylinders/{fn} imports from mpmd"
+                    for a in node.names:
+                        assert a.name != "mpmd", \
+                            f"cylinders/{fn} imports mpmd"
+
+    @pytest.mark.parametrize("fn", ["__init__.py", "exchange.py",
+                                    "slice_plan.py", "wheel.py"])
+    def test_mpmd_keeps_jax_lazy(self, fn):
+        roots = _top_level_import_roots(os.path.join(PKG_ROOT, "mpmd", fn))
+        for forbidden in ("jax", "ir", "parallel"):
+            assert forbidden not in roots, \
+                f"mpmd/{fn} imports {forbidden} at module top level"
+
+    def test_importing_mpmd_does_not_initialize_jax(self):
+        """The authoritative runtime check for the AST guard: a fresh
+        interpreter importing mpisppy_tpu.mpmd must not pull jax."""
+        code = ("import mpisppy_tpu.mpmd, sys; "
+                "assert 'jax' not in sys.modules, 'mpmd imported jax'")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run([sys.executable, "-c", code],
+                           cwd=os.path.dirname(PKG_ROOT),
+                           env=env, capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+
+
+class TestWheelCountersOff:
+    def test_stable_zero_keys_when_disabled(self):
+        telemetry.reset()
+        try:
+            c = telemetry.wheel_counters()
+            assert c["wheel_exchange_writes"] == 0
+            assert c["wheel_n_slices"] == 0
+            assert c["wheel_exchange_latency_seconds"] == 0.0
+            assert c["wheel_slice_bounds"] == {}
+        finally:
+            telemetry.reset()
