@@ -647,6 +647,7 @@ def worker_wheel_mpmd():
                                             ensure_cpu_backend)
     ensure_cpu_backend()
     import jax
+    import numpy as np
 
     from mpisppy_tpu import telemetry
     from mpisppy_tpu.cylinders.hub import PHHub
@@ -721,6 +722,14 @@ def worker_wheel_mpmd():
         "best_outer": round(ob, 3), "best_inner": round(ib, 3),
         "rel_gap": round(gap, 8), "certified": certified,
         "slices": plan.describe() if plan is not None else [],
+        # elastic recovery (PR 10): reslices applied, devices the hub
+        # reclaimed, and integrity-rejected window reads
+        "reslice_events": len(getattr(
+            getattr(ws, "supervisor", None), "reslice_log", ())),
+        "devices_reclaimed": getattr(
+            getattr(ws, "supervisor", None), "devices_reclaimed", 0),
+        "corrupt_reads_total": int(np.asarray(getattr(
+            ws.spcomm, "corrupt_reads", 0)).sum()),
         "device": jax.devices()[0].platform, "on_tpu": on_tpu,
         "scens": S, "iters": iters,
         **counters}

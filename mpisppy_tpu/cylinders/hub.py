@@ -69,8 +69,14 @@ class Hub(SPCommunicator):
             if self.options.get("bound_guard", True) else None)
         self._max_bound_rejects = int(
             self.options.get("max_bound_rejects", 25))
+        # payload-level integrity budget (read_checked rejections —
+        # checksum mismatch / write_id regression) per spoke; past it
+        # the spoke is pruned like a crashed one
+        self._max_corrupt_reads = int(
+            self.options.get("max_corrupt_reads", 10))
         # bound-progression + reject telemetry (null no-ops when off)
         self._c_rejects = self.telemetry.counter("window.bound_rejects")
+        self._c_corrupt = self.telemetry.counter("wheel.corrupt_reads")
         self._g_outer = self.telemetry.gauge("hub.best_outer")
         self._g_inner = self.telemetry.gauge("hub.best_inner")
 
@@ -154,6 +160,7 @@ class Hub(SPCommunicator):
             self.pairs.append(pair)
         self._spoke_read_ids = np.zeros(len(self.spokes), np.int64)
         self.bound_rejects = np.zeros(len(self.spokes), np.int64)
+        self.corrupt_reads = np.zeros(len(self.spokes), np.int64)
         self.has_outerbound_spokes = bool(self.outerbound_idx)
         self.has_innerbound_spokes = bool(self.innerbound_idx)
         # auto-wire extensions that consume a spoke's feed (the
@@ -252,10 +259,44 @@ class Hub(SPCommunicator):
                 f"{n} rejected bounds (last: {reason})"))
         return False
 
+    def _read_spoke_checked(self, i):
+        """Integrity-guarded window read of spoke i's to_hub mailbox:
+        (data, write_id, ok).  Backends without read_checked (the
+        multiproc SpokeHandle / NativeWindow path) fall back to the
+        plain read and are always ok.  A rejected snapshot counts into
+        the per-spoke corrupt-read budget — past it the spoke is pruned
+        exactly like a crashed one (and the MPMD supervisor reslices)."""
+        win = self.pairs[i].to_hub
+        rc = getattr(win, "read_checked", None)
+        if rc is None:
+            data, wid = win.read()
+            return data, wid, True
+        data, wid, ok, reason = rc()
+        if ok:
+            return data, wid, True
+        self.corrupt_reads[i] += 1
+        self._c_corrupt.inc()
+        self.telemetry.event("hub.corrupt_read", spoke=i,
+                             reason=str(reason))
+        n = int(self.corrupt_reads[i])
+        name = getattr(self.spokes[i], "spoke_name",
+                       type(self.spokes[i]).__name__)
+        if n == 1 or n % 10 == 0:
+            global_toc(f"WARNING: rejected corrupt window read from "
+                       f"spoke {i} ({name}): {reason} "
+                       f"[{n} rejected so far]")
+        if (n >= self._max_corrupt_reads
+                and not getattr(self.spokes[i], "_failed", False)):
+            self._mark_spoke_failed(i, RuntimeError(
+                f"{n} corrupt window reads (last: {reason})"))
+        return data, wid, False
+
     def receive_outerbounds(self):
         for i in list(self.outerbound_idx):
-            data, wid = self.pairs[i].to_hub.read()
+            data, wid, ok = self._read_spoke_checked(i)
             self._c_reads.inc()
+            if not ok:
+                continue
             if wid > self._spoke_read_ids[i]:
                 self._spoke_read_ids[i] = wid
                 if self._accept_bound("outer", float(data[0]), i):
@@ -265,8 +306,10 @@ class Hub(SPCommunicator):
 
     def receive_innerbounds(self):
         for i in list(self.innerbound_idx):
-            data, wid = self.pairs[i].to_hub.read()
+            data, wid, ok = self._read_spoke_checked(i)
             self._c_reads.inc()
+            if not ok:
+                continue
             if wid > self._spoke_read_ids[i]:
                 self._spoke_read_ids[i] = wid
                 if not self._accept_bound("inner", float(data[0]), i):
@@ -346,12 +389,26 @@ class PHHub(Hub):
             self._drain_failures()
             if self.supervisor is not None:
                 self.supervisor.poll()
+                # elastic recovery barrier: a supervisor that reslices
+                # (SliceSupervisor.on_sync) does it here, between the
+                # failure drain and this superstep's sends — the next
+                # W/nonant push already reflects the new plan
+                on_sync = getattr(self.supervisor, "on_sync", None)
+                if on_sync is not None:
+                    on_sync()
             self.send_ws()
             self.send_nonants()
             if self.drive_spokes_inline:
                 self._step_spokes()
             self.receive_outerbounds()
             self.receive_innerbounds()
+            if self.supervisor is not None:
+                # ensemble checkpoint hook: end-of-sync is the wheel's
+                # consistent cut (hub state committed, spokes stepped,
+                # bounds received)
+                end = getattr(self.supervisor, "on_sync_end", None)
+                if end is not None:
+                    end()
 
     def is_converged(self):
         # seed outer bound with the trivial bound once (reference
@@ -420,12 +477,19 @@ class LShapedHub(Hub):
             self._drain_failures()
             if self.supervisor is not None:
                 self.supervisor.poll()
+                on_sync = getattr(self.supervisor, "on_sync", None)
+                if on_sync is not None:
+                    on_sync()
             if send_nonants:
                 self.send_nonants()
             if self.drive_spokes_inline:
                 self._step_spokes()
             self.receive_outerbounds()
             self.receive_innerbounds()
+            if self.supervisor is not None:
+                end = getattr(self.supervisor, "on_sync_end", None)
+                if end is not None:
+                    end()
 
     def is_converged(self):
         # the hub's own loop provides both bounds; spokes may improve

@@ -24,6 +24,7 @@ import threading
 import numpy as np
 
 from .. import telemetry as _telemetry
+from ..resilience.bounds import PayloadGuard, payload_checksum
 
 
 class Window:
@@ -35,6 +36,9 @@ class Window:
       * `read()` returns (data_copy, write_id); the reader decides
         freshness by comparing ids (reference spoke.py:99-118)
       * write_id == -1 means terminate (reference hub.py:438)
+      * every write is stamped with a payload checksum;
+        `read_checked()` additionally validates the snapshot
+        (checksum + write_id monotonicity, resilience/bounds.py)
     """
 
     KILL = -1
@@ -43,11 +47,20 @@ class Window:
         self.length = int(length)
         self._buf = np.zeros(self.length + 1, dtype=np.float64)
         self._lock = threading.Lock()
+        self._checksum = payload_checksum(self._buf[:-1])
+        self._corrupt_next = False
+        self._pguard = PayloadGuard()
 
     @property
     def write_id(self):
         with self._lock:
             return int(self._buf[-1])
+
+    def corrupt_next_write(self):
+        """Chaos hook (corrupt_window mode): the next write stores a
+        perturbed payload under the checksum of the TRUE values, so
+        only payload validation — not value hygiene — can catch it."""
+        self._corrupt_next = True
 
     def write(self, values, write_id=None):
         """Post `values` with the next (or given) write_id."""
@@ -55,16 +68,33 @@ class Window:
         if values.shape != (self.length,):
             raise ValueError(
                 f"window expects shape ({self.length},), got {values.shape}")
+        chk = payload_checksum(values)
+        if self._corrupt_next:
+            self._corrupt_next = False
+            values = values.copy()
+            values[0] += 1.0
         with self._lock:
             new_id = int(self._buf[-1]) + 1 if write_id is None else write_id
             self._buf[:-1] = values
             self._buf[-1] = new_id
+            self._checksum = chk
             return new_id
 
     def read(self):
         """(data copy, write_id) — one atomic snapshot."""
         with self._lock:
             return self._buf[:-1].copy(), int(self._buf[-1])
+
+    def read_checked(self):
+        """(data, write_id, ok, reason) — one snapshot, integrity
+        validated against the writer's checksum and this reader's
+        high-water write_id.  Readers drop not-ok snapshots."""
+        with self._lock:
+            data = self._buf[:-1].copy()
+            wid = int(self._buf[-1])
+            chk = self._checksum
+        ok, reason = self._pguard.check(data, wid, chk)
+        return data, wid, ok, reason
 
     def send_kill(self):
         with self._lock:

@@ -56,17 +56,34 @@ class Spoke(SPCommunicator):
         """Post this spoke's vector (reference spoke.py:60)."""
         values = self.chaos.poison(values)
         self.chaos.pre_write()
+        fate = self.chaos.write_fate()
+        if fate == "drop":
+            return                     # partition_slice: the wire eats it
+        if fate == "corrupt":
+            corrupt = getattr(self.pair.to_hub, "corrupt_next_write", None)
+            if corrupt is not None:
+                corrupt()
         self.pair.to_hub.write(values)
         self._c_writes.inc()
 
     def spoke_from_hub(self):
         """(data, is_new): latest hub vector; is_new iff the write_id
-        advanced since our last read (reference spoke.py:93-118)."""
+        advanced since our last read AND the snapshot passed payload
+        validation (reference spoke.py:93-118 + read_checked)."""
         self.chaos.step_tick()
-        data, wid = self.pair.to_spoke.read()
+        win = self.pair.to_spoke
+        rc = getattr(win, "read_checked", None)
+        if rc is None:                 # backend without integrity guard
+            data, wid = win.read()
+            ok = True
+        else:
+            data, wid, ok, _reason = rc()
         self._c_reads.inc()
         if wid == Window.KILL:
             self._killed = True
+            return data, False
+        if not ok:                     # corrupt snapshot == stale
+            self._c_stale.inc()
             return data, False
         is_new = wid > self.last_hub_id
         if not is_new:
@@ -107,6 +124,42 @@ class Spoke(SPCommunicator):
         """Keep the to_hub write_id advancing so the supervisor can
         tell a slow spoke from a hung one; bound spokes override with
         a real re-post, the base is a no-op."""
+
+    # -- ensemble checkpoint hooks (resilience/checkpoint.py) -------------
+    def algo_state(self):
+        """npz-safe dict of this spoke's algorithm state for the wheel
+        ensemble checkpoint.  Subclasses extend; values must be
+        np.asarray-able (scalars/arrays) or None."""
+        state = {"last_hub_id": self.last_hub_id}
+        opt = self.opt
+        if getattr(opt, "_x_warm", None) is not None:
+            state["x_warm"] = np.asarray(opt._x_warm)
+        if getattr(opt, "_y_warm", None) is not None:
+            state["y_warm"] = np.asarray(opt._y_warm)
+        for k, (xw, yw) in (getattr(opt, "_named_warm", None) or {}).items():
+            state[f"named_warm.{k}.x"] = np.asarray(xw)
+            state[f"named_warm.{k}.y"] = np.asarray(yw)
+        return state
+
+    def restore_algo_state(self, state):
+        """Inverse of algo_state (missing keys keep defaults, so old
+        checkpoints restore what they have)."""
+        if "last_hub_id" in state:
+            self.last_hub_id = int(state["last_hub_id"])
+        opt = self.opt
+        if "x_warm" in state and hasattr(opt, "_x_warm"):
+            opt._x_warm = state["x_warm"]
+        if "y_warm" in state and hasattr(opt, "_y_warm"):
+            opt._y_warm = state["y_warm"]
+        named = {}
+        for k in state:
+            if k.startswith("named_warm.") and k.endswith(".x"):
+                name = k[len("named_warm."):-len(".x")]
+                yk = f"named_warm.{name}.y"
+                if yk in state:
+                    named[name] = (state[k], state[yk])
+        if named and hasattr(opt, "_named_warm"):
+            opt._named_warm.update(named)
 
     def main(self):
         """Threaded-mode driver loop (reference: each spoke's main)."""
@@ -201,6 +254,19 @@ class _BoundSpoke(Spoke):
         with open(self._trace_path, "a") as f:
             f.write(f"{time.time() - self._t0},{value}\n")
 
+    def algo_state(self):
+        state = super().algo_state()
+        state["bound"] = float(self.bound)
+        state["got_bound"] = bool(self._got_bound)
+        return state
+
+    def restore_algo_state(self, state):
+        super().restore_algo_state(state)
+        if "bound" in state:
+            self.bound = float(state["bound"])
+        if "got_bound" in state:
+            self._got_bound = bool(state["got_bound"])
+
 
 class _BoundWSpoke(_BoundSpoke):
     """Bound spoke that receives the hub's W vector (flattened (S*K,))
@@ -213,19 +279,25 @@ class _BoundWSpoke(_BoundSpoke):
         b = self.opt.batch
         return b.num_scens * b.num_nonants
 
+    def _reshape_SK(self, data):
+        """(S, K) view of a flattened hub vector.  After an elastic
+        reslice the hub's batch may carry MORE pad rows than this
+        spoke's (pads always append at the end), so truncate to the
+        local scenario count instead of requiring an exact match."""
+        b = self.opt.batch
+        return np.asarray(data).reshape(-1, b.num_nonants)[:b.num_scens]
+
     @property
     def localWs(self):
         """Pure read of the hub's latest W — does NOT consume the
         freshness flag (use fresh_Ws in step loops)."""
         data, _ = self.pair.to_spoke.read()
-        b = self.opt.batch
-        return data.reshape(b.num_scens, b.num_nonants)
+        return self._reshape_SK(data)
 
     def fresh_Ws(self):
         """(W (S,K), is_new)"""
         data, is_new = self.spoke_from_hub()
-        b = self.opt.batch
-        return data.reshape(b.num_scens, b.num_nonants), is_new
+        return self._reshape_SK(data), is_new
 
 
 class _BoundNonantSpoke(_BoundSpoke):
@@ -236,17 +308,21 @@ class _BoundNonantSpoke(_BoundSpoke):
         b = self.opt.batch
         return b.num_scens * b.num_nonants
 
+    def _reshape_SK(self, data):
+        """(S, K) view, truncating extra post-reslice pad rows (see
+        _BoundWSpoke._reshape_SK)."""
+        b = self.opt.batch
+        return np.asarray(data).reshape(-1, b.num_nonants)[:b.num_scens]
+
     def fresh_nonants(self):
         data, is_new = self.spoke_from_hub()
-        b = self.opt.batch
-        return data.reshape(b.num_scens, b.num_nonants), is_new
+        return self._reshape_SK(data), is_new
 
     @property
     def localnonants(self):
         """Pure read — does NOT consume the freshness flag."""
         data, _ = self.pair.to_spoke.read()
-        b = self.opt.batch
-        return data.reshape(b.num_scens, b.num_nonants)
+        return self._reshape_SK(data)
 
 
 class InnerBoundNonantSpoke(_BoundNonantSpoke):
@@ -269,6 +345,17 @@ class InnerBoundNonantSpoke(_BoundNonantSpoke):
                      or not self._got_bound)):
             self.best_solution = np.asarray(solution)
         return super().update_if_improving(candidate)
+
+    def algo_state(self):
+        state = super().algo_state()
+        if self.best_solution is not None:
+            state["best_solution"] = np.asarray(self.best_solution)
+        return state
+
+    def restore_algo_state(self, state):
+        super().restore_algo_state(state)
+        if "best_solution" in state:
+            self.best_solution = np.asarray(state["best_solution"])
 
 
 class OuterBoundNonantSpoke(_BoundNonantSpoke):

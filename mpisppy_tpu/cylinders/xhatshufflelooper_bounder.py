@@ -86,3 +86,25 @@ class XhatShuffleInnerBound(InnerBoundNonantSpoke):
         if feas and self.update_if_improving(obj, solution=cand):
             self.cycler.best = base
         return True
+
+    # -- ensemble checkpoint (resilience/checkpoint.py) -------------------
+    def algo_state(self):
+        state = super().algo_state()
+        state["cycler_pos"] = int(self.cycler._pos)
+        state["cycler_direction"] = int(self.cycler._direction)
+        if self.cycler.best is not None:
+            state["cycler_best"] = int(self.cycler.best)
+        if self._last_nonants is not None:
+            state["last_nonants"] = np.asarray(self._last_nonants)
+        return state
+
+    def restore_algo_state(self, state):
+        super().restore_algo_state(state)
+        if "cycler_pos" in state:
+            self.cycler._pos = int(state["cycler_pos"])
+        if "cycler_direction" in state:
+            self.cycler._direction = int(state["cycler_direction"])
+        if "cycler_best" in state:
+            self.cycler.best = int(state["cycler_best"])
+        if "last_nonants" in state:
+            self._last_nonants = np.asarray(state["last_nonants"])

@@ -9,7 +9,10 @@ Three pieces:
     registered below as the "device" window backend;
   * `MPMDWheel` + `SliceSupervisor` (wheel.py) — one controller thread
     per slice, spoke supersteps overlapping hub supersteps, per-slice
-    supervision and telemetry.
+    supervision and telemetry;
+  * `ReslicePlanner` (reslice.py) — successor plans after a slice
+    dies: the supervisor live-applies them, returning a pruned spoke's
+    devices to the hub (elastic recovery, doc/src/mpmd.md).
 
 Importing this package is what makes WindowPair(backend="device")
 resolvable — the WheelSpinner seam imports it lazily when it selects
@@ -20,6 +23,7 @@ mpisppy_tpu.mpmd does not initialize the accelerator runtime.
 
 from ..cylinders.spcommunicator import register_window_backend
 from .exchange import DeviceWindow, device_window_pair
+from .reslice import ReslicePlanner
 from .slice_plan import CylinderSlice, SlicePlan
 from .wheel import MPMDWheel, SliceSupervisor
 
@@ -29,6 +33,7 @@ __all__ = [
     "CylinderSlice",
     "DeviceWindow",
     "MPMDWheel",
+    "ReslicePlanner",
     "SlicePlan",
     "SliceSupervisor",
     "device_window_pair",

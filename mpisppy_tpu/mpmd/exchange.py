@@ -30,6 +30,7 @@ import time
 import numpy as np
 
 from .. import telemetry as _telemetry
+from ..resilience.bounds import PayloadGuard, payload_checksum
 
 
 class DeviceWindow:
@@ -55,7 +56,10 @@ class DeviceWindow:
         self._c_stale = tel.counter("wheel.stale_reads")
         self._h_latency = tel.histogram("wheel.exchange_seconds")
         self._last_read_wid = 0
+        self._corrupt_next = False
+        self._pguard = PayloadGuard()
         # pre-first-write reads must match Window: zeros with id 0
+        self._checksum = payload_checksum(np.zeros(self.length))
         self._payload = self._put(np.zeros(self.length, dtype=np.float64))
 
     def _put(self, values):
@@ -77,6 +81,13 @@ class DeviceWindow:
             raise ValueError(
                 f"window expects shape ({self.length},), "
                 f"got {values.shape}")
+        chk = payload_checksum(values)
+        if self._corrupt_next:
+            # chaos corrupt_window: ship a perturbed payload under the
+            # checksum of the true values (read_checked must catch it)
+            self._corrupt_next = False
+            values = values.copy()
+            values[0] += 1.0
         t0 = time.perf_counter()
         arr = self._put(values)
         arr.block_until_ready()
@@ -87,6 +98,7 @@ class DeviceWindow:
             new_id = self._wid + 1 if write_id is None else int(write_id)
             self._payload = arr
             self._wid = new_id
+            self._checksum = chk
             return new_id
 
     def read(self):
@@ -100,6 +112,25 @@ class DeviceWindow:
                 self._c_stale.inc()
             self._last_read_wid = wid
         return np.asarray(arr, dtype=np.float64), wid
+
+    def read_checked(self):
+        """(data, write_id, ok, reason) — one snapshot, integrity
+        validated (checksum + monotone write_id, PayloadGuard).
+        Corrupt snapshots are also counted as stale for the window's
+        own traffic accounting."""
+        with self._lock:
+            arr, wid, chk = self._payload, self._wid, self._checksum
+        data = np.asarray(arr, dtype=np.float64)
+        ok, reason = self._pguard.check(data, wid, chk)
+        if wid != self.KILL:
+            if not ok or (wid == self._last_read_wid and wid > 0):
+                self._c_stale.inc()
+            self._last_read_wid = wid
+        return data, wid, ok, reason
+
+    def corrupt_next_write(self):
+        """Chaos hook (corrupt_window mode) — see Window."""
+        self._corrupt_next = True
 
     def read_device(self):
         """(device-resident payload, write_id) without a host copy —

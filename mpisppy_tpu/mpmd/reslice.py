@@ -1,0 +1,81 @@
+"""ReslicePlanner — successor SlicePlans after a slice dies.
+
+PR 9 made every cylinder a fault domain on its own device slice, but a
+pruned spoke's devices were simply lost.  Elastic recovery treats
+slice membership as mutable (the MPMD-pipelining systems of
+arXiv:2412.14374 do the same for pipeline stages): when the
+SliceSupervisor prunes a spoke past its restart budget — or a chaos
+device_loss hits its slice — the planner computes a successor plan
+with the dead slice removed and its devices merged into a surviving
+slice, and the wheel live-applies it behind the hub's sync barrier
+(SliceSupervisor.on_sync -> apply_reslice):
+
+  1. the hub optimizer reshards onto the grown submesh
+     (PHBase.reshard: re-pad to the new plan's pad_multiple, carry
+     PHState over row-for-row — the hub never restarts);
+  2. hub->spoke mailboxes whose (S*K,) length changed are rebuilt and
+     the last committed payload is re-posted under its OLD write_id,
+     so surviving spokes' freshness comparisons stay monotone;
+  3. the next send_ws/send_nonants — the very next statements of the
+     same sync — already flow through the new plan, which is how a
+     reslice completes "within 2 supersteps" of the prune.
+
+Randomized-PH convergence theory (PAPERS.md) tolerates exactly the
+stale/missing spoke contributions this transition produces, so the
+wheel's certified verdict is unchanged by a mid-run reslice.
+
+This module is jax-free (AST-guarded with the rest of mpmd): plans are
+pure device-list bookkeeping.
+"""
+
+from __future__ import annotations
+
+from .slice_plan import CylinderSlice, SlicePlan
+
+
+class ReslicePlanner:
+    """Compute successor plans when a slice dies.
+
+    target="hub" (the default, and the only target the supervisor
+    live-applies) returns the dead slice's devices to the hub — they
+    are APPENDED after the hub's existing devices, so the hub's first
+    device (where every to_hub mailbox lives) is unchanged and
+    existing spoke->hub wiring survives the transition.
+
+    target="starved" grows the smallest surviving spoke slice instead
+    — the static-planning policy for building a recovery plan offline
+    (e.g. for a checkpoint resume that restarts dead slices on a
+    rebalanced fleet)."""
+
+    def __init__(self, target="hub"):
+        if target not in ("hub", "starved"):
+            raise ValueError(
+                f"reslice target must be 'hub' or 'starved', "
+                f"got {target!r}")
+        self.target = target
+
+    def successor(self, plan: SlicePlan, dead: CylinderSlice):
+        """(new_plan, reclaimed_devices) with `dead` removed and its
+        devices merged into the target slice.  The surviving slices
+        keep their identity (same CylinderSlice objects) except the
+        grown one, which is rebuilt with the extended device tuple."""
+        if dead == plan.hub:
+            raise ValueError("the hub slice cannot be resliced away")
+        survivors = [s for s in plan.slices if s is not dead]
+        if len(survivors) == len(plan.slices):
+            # not the same object — fall back to equality (a plan
+            # round-tripped through describe()/rebuild)
+            survivors = [s for s in plan.slices if s != dead]
+        if len(survivors) == len(plan.slices):
+            raise ValueError(
+                f"slice {dead.name!r} is not part of this plan")
+        reclaimed = tuple(dead.devices)
+        if self.target == "starved" and len(survivors) > 1:
+            k = min(range(1, len(survivors)),
+                    key=lambda j: survivors[j].n_devices)
+        else:
+            k = 0
+        grown = survivors[k]
+        survivors[k] = CylinderSlice(
+            grown.name, grown.index, tuple(grown.devices) + reclaimed)
+        return SlicePlan(survivors), reclaimed

@@ -36,9 +36,11 @@ from __future__ import annotations
 import threading
 import time
 
+import numpy as np
+
 from .. import global_toc
 from .. import telemetry as _telemetry
-from ..resilience.chaos import ChaosInjector
+from ..resilience.chaos import ChaosInjector, DeviceLossError
 from ..resilience.supervisor import restart_delay
 from ..spin_the_wheel import WheelSpinner
 from .slice_plan import SlicePlan
@@ -78,6 +80,20 @@ class SliceSupervisor:
         self._shutting_down = False
         self.hub_t0 = None
         self.hub_t1 = None
+        # elastic recovery (doc/src/mpmd.md "Elastic recovery"):
+        # _slice_of maps spoke position -> its CURRENT CylinderSlice
+        # (survives earlier reslices, unlike positional plan indexing)
+        self._slice_of = {i: plan.spokes[i] for i in range(n)}
+        self.reslice_enabled = bool(o.get("reslice", True))
+        self._reslice_target = str(o.get("reslice_target", "hub"))
+        self._resliced = set()
+        self.reslice_log = []
+        self.devices_reclaimed = 0
+        # wheel-level ensemble checkpoints (resilience/checkpoint.py):
+        # written at the end of every checkpoint_every-th hub sync
+        self._wheel_ckpt = o.get("wheel_checkpoint")
+        self._ckpt_every = int(o.get("checkpoint_every", 1))
+        self._last_ckpt_it = 0
         self._tel = getattr(hub, "telemetry", None) or _telemetry.get()
         for i, sp in enumerate(self.spokes):
             self._wrap_step(sp, i)
@@ -130,6 +146,16 @@ class SliceSupervisor:
              "incarnation": self.restarts[i], "error": str(exc)})
         if self._shutting_down or sp.got_kill_signal():
             return                     # the wheel is over; don't relaunch
+        if isinstance(exc, DeviceLossError):
+            # the slice's hardware is gone: restarting on it is futile —
+            # skip the budget and prune straight into the reslice path
+            self.spokes_failed += 1
+            self._tel.event("wheel.slice_device_loss", slice=i + 1,
+                            reason=str(exc))
+            self._tel.counter("wheel.slices_failed").inc()
+            self.hub.report_spoke_failure(sp, RuntimeError(
+                f"unrestartable: {exc}"))
+            return
         if self.restarts[i] < self.max_restarts:
             self.restarts[i] += 1
             self.spoke_restarts += 1
@@ -189,21 +215,136 @@ class SliceSupervisor:
                     self.hub.report_spoke_failure(sp, RuntimeError(
                         f"slice hung: no window write for {age:.1f}s"))
 
+    # -- elastic recovery (hub thread, via Hub.sync getattr hooks) --------
+    def on_sync(self):
+        """Reslice barrier: runs at the START of every hub sync (after
+        the failure drain), so a spoke pruned on ANY path — thread
+        crash, device loss, hang, bound-reject or corrupt-read budget —
+        gets its devices reclaimed before this superstep's sends."""
+        if not self.reslice_enabled or self._shutting_down:
+            return
+        for i, sp in enumerate(self.spokes):
+            if getattr(sp, "_failed", False) and i not in self._resliced:
+                self._resliced.add(i)
+                try:
+                    self.apply_reslice(i)
+                except Exception as e:
+                    global_toc(f"WARNING: reslice after slice {i + 1} "
+                               f"failure failed: {e}")
+
+    def apply_reslice(self, i):
+        """Return the dead slice i's devices to the hub: successor
+        plan, hub reshard onto the grown submesh, and — when the hub's
+        padded scenario count changed — rebuilt hub->spoke mailboxes
+        whose last payload is re-posted under its OLD write_id so
+        surviving spokes' freshness checks stay monotone."""
+        from .reslice import ReslicePlanner
+
+        dead = self._slice_of.pop(i)
+        target = self._reslice_target
+        if target != "hub":
+            # only hub reclamation is safe to live-apply (growing a
+            # running spoke's mesh under its controller thread is not);
+            # "starved" remains a static-planning policy
+            global_toc(f"WARNING: reslice_target={target!r} cannot be "
+                       "live-applied; reclaiming to the hub instead")
+            target = "hub"
+        new_plan, reclaimed = ReslicePlanner(target=target).successor(
+            self.plan, dead)
+        self.plan = new_plan
+        it = self.hub.current_iteration()
+        hub_opt = self.hub.opt
+        old_S = hub_opt.batch.num_scens
+        hub_opt.reshard(new_plan.hub.mesh(),
+                        pad_multiple=new_plan.pad_multiple())
+        new_S = hub_opt.batch.num_scens
+        if new_S != old_S:
+            K = hub_opt.batch.num_nonants
+            self._regrow_windows(new_S * K)
+        self.devices_reclaimed += len(reclaimed)
+        event = {"slice": i + 1, "name": dead.name, "iteration": it,
+                 "devices_reclaimed": len(reclaimed),
+                 "hub_devices": new_plan.hub.n_devices,
+                 "padded_scens": new_S}
+        self.reslice_log.append(event)
+        # "name" would collide with Telemetry.event's own first arg
+        self._tel.event("wheel.reslice", **dict(
+            {k: v for k, v in event.items() if k != "name"},
+            slice_name=dead.name))
+        self._tel.counter("wheel.reslice_events").inc()
+        self._tel.counter("wheel.devices_reclaimed").inc(len(reclaimed))
+        self._tel.gauge("wheel.n_slices").set(new_plan.n_slices)
+        global_toc(f"reslice: slice {i + 1} ({dead.name}) pruned at "
+                   f"iter {it}; {len(reclaimed)} device(s) returned to "
+                   f"the hub ({new_plan.hub.n_devices} total)")
+
+    def _regrow_windows(self, new_len):
+        """Rebuild surviving hub->spoke mailboxes at the new (S*K,)
+        length.  The last committed payload is carried over (truncated
+        readers only consume their own leading rows) and re-posted
+        under the OLD write_id: a fresh window would restart ids at 1,
+        which is < the spoke's last_hub_id and would freeze its
+        freshness check forever."""
+        for j, sp in enumerate(self.spokes):
+            if getattr(sp, "_failed", False) or sp.pair is None:
+                continue
+            old = sp.pair.to_spoke
+            if old.length == new_len:
+                continue
+            if hasattr(old, "device"):       # DeviceWindow placement
+                new_win = type(old)(new_len, device=old.device,
+                                    tag=old.tag)
+            else:
+                new_win = type(old)(new_len)
+            old_data, old_wid = old.read()
+            if old_wid not in (0, old.KILL):
+                payload = np.zeros(new_len)
+                n = min(new_len, old_data.shape[0])
+                payload[:n] = old_data[:n]
+                new_win.write(payload, write_id=old_wid)
+            old.close()
+            # sp.pair is the hub's pairs[j] object too — one swap
+            # covers both endpoints; readers tolerate either window
+            # during the handoff (old stays readable until collected)
+            sp.pair.to_spoke = new_win
+
+    def on_sync_end(self):
+        """Ensemble checkpoint hook: END of hub sync is the wheel's
+        consistent cut — hub state committed for this iteration,
+        spokes stepped (lockstep) and bounds received — so a resume
+        continues at the next iteration with the whole wheel intact."""
+        if not self._wheel_ckpt or self._shutting_down:
+            return
+        it = self.hub.current_iteration()
+        if it <= self._last_ckpt_it or it % self._ckpt_every != 0:
+            return
+        self._last_ckpt_it = it
+        from ..resilience.checkpoint import save_wheel_ensemble
+        save_wheel_ensemble(self._wheel_ckpt, self.hub,
+                            plan=self.plan.describe())
+        self._tel.event("wheel.checkpoint", path=str(self._wheel_ckpt),
+                        iteration=it)
+
     # -- shutdown (after hub.send_terminate) ------------------------------
     def shutdown(self, timeout=120.0):
-        """Per-thread bounded join (the threaded wheel's policy): a
-        slice still alive past its budget is escalated through the
-        failure-pruning path and its daemon thread dies with the
-        process."""
+        """Join controller threads against ONE global budget: each
+        pending thread gets the remaining time divided by the threads
+        still unjoined, so a hung first thread cannot consume the whole
+        budget and leak the rest.  A slice still alive past its share
+        is escalated through the failure-pruning path and its daemon
+        thread dies with the process."""
         self._shutting_down = True
-        for i, th in enumerate(self.threads):
-            if th is None:
-                continue
-            th.join(timeout=timeout)
+        deadline = time.monotonic() + float(timeout)
+        pending = [(i, th) for i, th in enumerate(self.threads)
+                   if th is not None and th.is_alive()]
+        for k, (i, th) in enumerate(pending):
+            remaining = max(0.0, deadline - time.monotonic())
+            share = remaining / (len(pending) - k)
+            th.join(timeout=share)
             if th.is_alive():
                 self.hub.report_spoke_failure(self.spokes[i], TimeoutError(
-                    f"slice did not exit within {timeout:.0f}s of the "
-                    "kill signal"))
+                    f"slice did not exit within its {share:.1f}s share "
+                    f"of the {timeout:.0f}s shutdown budget"))
 
     # -- accounting -------------------------------------------------------
     def overlap_fraction(self):
@@ -232,8 +373,10 @@ class SliceSupervisor:
                  "failed": bool(getattr(sp, "_failed", False)),
                  "restarts": self.restarts[i],
                  "busy_seconds": round(self._busy[i], 4),
-                 "devices": [str(d) for d in
-                             self.plan.slices[i + 1].devices]}
+                 # via _slice_of, not positional plan indexing: after a
+                 # reslice the plan no longer carries pruned slices
+                 "devices": ([str(d) for d in self._slice_of[i].devices]
+                             if i in self._slice_of else [])}
                 for i, sp in enumerate(self.spokes)]
 
 
@@ -313,6 +456,18 @@ class MPMDWheel(WheelSpinner):
         hub = hd["hub_class"](hub_opt, spokes, options=hub_options)
         hub.setup_hub()
         self._restore_hub_bounds(hub)
+        # ensemble resume: the hub optimizer's PH state already rides
+        # options["resume_from"] -> load_run_checkpoint (the wheel file
+        # is a superset of the run-checkpoint keys); here the SPOKES
+        # and window payloads come back, so the spin continues with the
+        # whole wheel intact — failed-at-save slices restart fresh
+        if self.resume_from is not None:
+            from ..resilience.checkpoint import (is_wheel_checkpoint,
+                                                 load_wheel_ensemble)
+            if is_wheel_checkpoint(self.resume_from):
+                load_wheel_ensemble(self.resume_from, hub)
+                global_toc(f"MPMDWheel: ensemble restored from "
+                           f"{self.resume_from}")
         self.spcomm = hub
         hub.telemetry.gauge("wheel.n_slices").set(plan.n_slices)
 

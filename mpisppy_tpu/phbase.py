@@ -349,6 +349,77 @@ class PHBase(SPOpt):
         na = self.batch.nonant_idx
         return int(jnp.sum(self.lb_eff[:, na] == self.ub_eff[:, na]))
 
+    # -- elastic re-slicing (mpmd/reslice.py; doc/src/mpmd.md) ------------
+    def reshard(self, mesh, pad_multiple=1):
+        """Move this optimizer onto a NEW ScenarioMesh mid-run — the
+        hub side of a dynamic reslice: the current batch is re-padded
+        to the new plan's pad_multiple (pads always APPEND, so existing
+        scenario rows keep their indices and window row semantics),
+        every scenario-leading state array is zero-extended onto the
+        new rows, all device state is re-placed on the new mesh, and
+        the solver prep is rebuilt there.  The hub never restarts:
+        PHState — duals, consensus, the iteration counter — carries
+        over row-for-row.  Returns the new padded scenario count."""
+        from .ir import SplitA, pad_scenarios, shared_density
+
+        S_old = self.batch.num_scens
+        q = max(int(pad_multiple), 1)
+        Spad = ((S_old + q - 1) // q) * q
+        self.mesh = mesh
+        self.batch = mesh.shard_batch(pad_scenarios(self.batch, Spad))
+        S_new = self.batch.num_scens
+        dS = S_new - S_old
+
+        def grow(a, fill=0.0):
+            # zero-extend an (S_old, ...) array to (S_new, ...) and
+            # commit it to the new mesh (arrays committed to the OLD
+            # mesh cannot feed a jit over the new one)
+            a = np.asarray(a)
+            if dS > 0:
+                pad = np.full((dS,) + a.shape[1:], fill, a.dtype)
+                a = np.concatenate([a, pad])
+            return mesh.shard_like_batch(a)
+
+        def scal(a):
+            return mesh.replicate(np.asarray(a))
+
+        st = self.state
+        if st is not None:
+            self.state = PHState(
+                x=grow(st.x), y=grow(st.y), W=grow(st.W),
+                xbar=grow(st.xbar), xsqbar=grow(st.xsqbar),
+                obj=grow(st.obj), dual_obj=grow(st.dual_obj),
+                conv=scal(st.conv), it=scal(st.it),
+                solve_iters=scal(st.solve_iters),
+                active_frac=scal(st.active_frac),
+                solve_restarts=scal(st.solve_restarts),
+                promoted=scal(st.promoted))
+        self.rho = grow(self.rho,
+                        float(self.options.get("defaultPHrho", 1.0)))
+        # effective bounds keep their (possibly extension-pinned) rows;
+        # the fresh batch supplies the new pad rows
+        lb = np.concatenate([np.asarray(self.lb_eff),
+                             np.asarray(self.batch.lb)[S_old:]])
+        ub = np.concatenate([np.asarray(self.ub_eff),
+                             np.asarray(self.batch.ub)[S_old:]])
+        self.lb_eff = mesh.shard_like_batch(lb)
+        self.ub_eff = mesh.shard_like_batch(ub)
+        # every shape/placement-keyed cache is stale now; the next
+        # superstep retraces on the new (S, ...) shapes
+        self.prep = self._build_prep(hot=self.solver.hot_dtype)
+        self._shared_nnz_frac = (float(shared_density(self.prep.A))
+                                 if isinstance(self.prep.A, SplitA)
+                                 else None)
+        self.solver_eps = jnp.asarray(np.asarray(self.solver_eps),
+                                      self.batch.c.dtype)
+        self._promoted_cache = None
+        self._np_cache = {}
+        self._phase_jits = None
+        self.clear_warmstart()
+        global_toc(f"reshard: {S_old} -> {S_new} padded scenarios on "
+                   f"{mesh.size} device(s)")
+        return S_new
+
     # -- Iter0 (reference phbase.py:758-872) ------------------------------
     def Iter0(self):
         self._ext("pre_iter0")

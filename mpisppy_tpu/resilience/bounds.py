@@ -21,7 +21,52 @@ its budget, at which point the hub prunes the spoke through the same
 
 from __future__ import annotations
 
+import zlib
+
 import numpy as np
+
+
+def payload_checksum(values) -> int:
+    """CRC32 over the float64 byte image of a window payload.
+
+    Both window backends stamp every write with this checksum and
+    `read_checked()` recomputes it on the reader's copy, so a torn
+    snapshot or a corrupted mailbox is detected at the read boundary
+    instead of flowing into bound/W/nonant state."""
+    arr = np.ascontiguousarray(values, dtype=np.float64)
+    return zlib.crc32(arr.tobytes()) & 0xFFFFFFFF
+
+
+class PayloadGuard:
+    """Payload-level twin of BoundGuard for one window direction.
+
+    Validates each `(data, write_id, checksum)` snapshot a reader
+    takes: the byte image must match the writer's stamped checksum and
+    the write_id must never regress below the highest id this reader
+    has seen (the kill sentinel -1 is exempt — it carries no payload).
+    Rejections drop the message; the hub counts them per spoke into
+    the same prune budget that bound rejections feed."""
+
+    KILL = -1
+
+    def __init__(self):
+        self.max_wid = 0
+        self.corrupt = 0
+
+    def check(self, values, write_id, checksum):
+        """(ok, reason) for one window snapshot."""
+        wid = int(write_id)
+        if wid == self.KILL:
+            return True, None
+        if wid < self.max_wid:
+            self.corrupt += 1
+            return False, (f"write_id regressed: {wid} after "
+                           f"{self.max_wid}")
+        self.max_wid = wid
+        if checksum is not None and payload_checksum(values) != int(checksum):
+            self.corrupt += 1
+            return False, f"payload checksum mismatch at write_id {wid}"
+        return True, None
 
 
 class BoundGuard:

@@ -37,6 +37,14 @@ class ChaosError(RuntimeError):
     """An injected failure (never raised outside chaos runs)."""
 
 
+class DeviceLossError(ChaosError):
+    """An injected loss of the slice's device(s).
+
+    Unlike a soft crash, the hardware is gone: SliceSupervisor treats
+    this as unrestartable and prunes the slice immediately (skipping
+    the restart budget), which triggers the elastic reslice path."""
+
+
 class ChaosInjector:
     """One injector instance per owning cylinder; all state local.
 
@@ -51,6 +59,18 @@ class ChaosInjector:
       delay_write_s: float sleep before every outgoing write
       crash_at_iter: int   hub-side: raise ChaosError at PH iter N
                            (after that iteration's checkpoint)
+      device_loss: int     raise DeviceLossError on the N-th step tick
+                           (unrestartable: the supervisor prunes the
+                           slice and reslices without burning restarts)
+      corrupt_window: int  from the N-th outgoing write on, corrupt
+                           the posted payload (checksum stays that of
+                           the true values, so read_checked rejects)
+      partition_slice: int from the N-th outgoing write on, silently
+                           drop every write (the slice looks
+                           partitioned away: its write_id goes stale
+                           and hang pruning fires)
+      block_build_fail: int streaming: fail the first N source block
+                           builds (retry/backoff tests)
     """
 
     HARD_EXIT_CODE = 13
@@ -58,6 +78,8 @@ class ChaosInjector:
     def __init__(self, config=None):
         self.config = dict(config or {})
         self.steps = 0
+        self.writes = 0
+        self.builds = 0
 
     @classmethod
     def from_options(cls, config=None):
@@ -89,6 +111,9 @@ class ChaosInjector:
             # notice via write_id staleness, not process death
             while True:          # pragma: no cover - killed externally
                 time.sleep(0.25)
+        if c.get("device_loss") and self.steps >= int(c["device_loss"]):
+            raise DeviceLossError(
+                f"injected device loss at step {self.steps}")
         if c.get("crash_at_step") and self.steps >= int(c["crash_at_step"]):
             if c.get("hard_exit"):
                 # no cleanup, no atexit, nonzero rc — the in-process
@@ -107,6 +132,39 @@ class ChaosInjector:
         d = float(self.config.get("delay_write_s", 0) or 0)
         if d > 0:
             time.sleep(d)
+
+    def write_fate(self):
+        """"ok" | "drop" | "corrupt" for the next outgoing write.
+
+        partition_slice drops writes (the slice goes silent — its
+        heartbeat id stops advancing), corrupt_window flips the posted
+        payload under an honest checksum so payload validation, not
+        value hygiene, must catch it.  Both apply from the N-th write
+        on, so heartbeat re-posts keep feeding the corrupt-read budget
+        until the hub prunes the slice."""
+        if not self.config:
+            return "ok"
+        self.writes += 1
+        c = self.config
+        if (c.get("partition_slice")
+                and self.writes >= int(c["partition_slice"])):
+            return "drop"
+        if (c.get("corrupt_window")
+                and self.writes >= int(c["corrupt_window"])):
+            return "corrupt"
+        return "ok"
+
+    # -- streaming-side ---------------------------------------------------
+    def block_build_tick(self):
+        """Fail the first N scenario-block builds (streaming retry
+        tests); the retry wrapper re-enters here on each attempt."""
+        n = self.config.get("block_build_fail")
+        if not n:
+            return
+        self.builds += 1
+        if self.builds <= int(n):
+            raise ChaosError(
+                f"injected block build failure {self.builds}/{int(n)}")
 
     # -- hub-side ---------------------------------------------------------
     def hub_iter_tick(self, k):

@@ -42,16 +42,30 @@ def _opt_load(v):
     return None if np.isnan(v) else v
 
 
-def save_run_checkpoint(path, opt):
-    """Atomically persist the full run state of `opt` (a PHBase with a
-    live `state`); hub-level bounds ride along when `opt.spcomm` is a
-    hub."""
+def _atomic_savez(path, payload):
+    """Write `payload` as <path>.npz via tmp-file + os.replace, so a
+    reader (or a resume after a crash mid-write) never sees a torn
+    file.  savez on a FILE OBJECT keeps the name verbatim (the path
+    form appends .npz, which would break the replace pairing)."""
+    real = _norm_npz(path)
+    tmp = real + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **payload)
+    os.replace(tmp, real)
+    return real
+
+
+def _run_payload(opt):
+    """The run-checkpoint key set for `opt` (a PHBase with a live
+    `state`) — shared by save_run_checkpoint and the wheel ensemble
+    (whose file is a strict SUPERSET of this, so load_run_checkpoint /
+    restore_hub work unchanged on either format)."""
     st = opt.state
     if st is None:
         raise RuntimeError("cannot checkpoint before Iter0 (no state)")
     hub = getattr(opt, "spcomm", None)
     incumbent = getattr(hub, "best_nonant_solution", None)
-    payload = {
+    return {
         "x": np.asarray(st.x), "y": np.asarray(st.y),
         "W": np.asarray(st.W), "xbar": np.asarray(st.xbar),
         "xsqbar": np.asarray(st.xsqbar),
@@ -78,14 +92,13 @@ def save_run_checkpoint(path, opt):
         "incumbent": (np.asarray(incumbent) if incumbent is not None
                       else np.array([])),
     }
-    real = _norm_npz(path)
-    tmp = real + ".tmp"
-    # savez on a FILE OBJECT keeps the name verbatim (the path form
-    # appends .npz, which would break the replace pairing)
-    with open(tmp, "wb") as f:
-        np.savez_compressed(f, **payload)
-    os.replace(tmp, real)
-    return real
+
+
+def save_run_checkpoint(path, opt):
+    """Atomically persist the full run state of `opt` (a PHBase with a
+    live `state`); hub-level bounds ride along when `opt.spcomm` is a
+    hub."""
+    return _atomic_savez(path, _run_payload(opt))
 
 
 def load_run_checkpoint(path, opt):
@@ -192,12 +205,7 @@ def save_stream_checkpoint(path, sph):
         "warm_y": (np.asarray(warm[1]) if warm is not None
                    else np.array([])),
     }
-    real = _norm_npz(path)
-    tmp = real + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez_compressed(f, **payload)
-    os.replace(tmp, real)
-    return real
+    return _atomic_savez(path, payload)
 
 
 def load_stream_checkpoint(path, sph):
@@ -265,3 +273,129 @@ def restore_hub(path, hub):
     if inc.size:
         hub.best_nonant_solution = inc
     return hub
+
+
+# -- wheel ensemble checkpoints (MPMD wheel, PR 10) -----------------------
+#
+# One atomic file for the WHOLE wheel: the hub's run-checkpoint keys
+# (a strict superset, so load_run_checkpoint / restore_hub read a
+# wheel file unchanged — and a pre-wheel run checkpoint is still a
+# valid `resume_from` for the wheel, restoring the hub and starting
+# the spokes fresh), plus `wheel_format`, the serialized SlicePlan,
+# per-spoke algorithm state from Spoke.algo_state(), the last
+# committed payload + write_id of every pair's mailboxes, and the
+# hub's per-spoke accounting vectors.  Restoring all of it makes a
+# lockstep wheel resume bit-replay the uninterrupted spin; spokes
+# marked failed at save time are NOT restored, so a post-failure
+# resume restarts only the dead slices.
+#
+# This module never imports mpmd (AST-guarded): everything here goes
+# through the generic hub/spoke/Window interfaces.
+
+def is_wheel_checkpoint(path):
+    """True when `path` is an ensemble (wheel_format) checkpoint, not
+    a plain PH run checkpoint."""
+    if not checkpoint_exists(path):
+        return False
+    with np.load(_norm_npz(path), allow_pickle=True) as z:
+        return "wheel_format" in z
+
+
+def save_wheel_ensemble(path, hub, plan=None):
+    """Atomically persist the full wheel: hub PH state + bounds, every
+    live spoke's algorithm state, the last-committed window payloads
+    and write-id vector, and the current slice plan (pass
+    `plan=SlicePlan.describe()`)."""
+    import json
+
+    payload = _run_payload(hub.opt)
+    payload["wheel_format"] = np.int64(1)
+    payload["wheel_n_spokes"] = np.int64(len(hub.spokes))
+    if plan is not None:
+        payload["wheel_plan"] = np.array(json.dumps(plan))
+    payload["wheel_spoke_read_ids"] = np.asarray(hub._spoke_read_ids)
+    payload["wheel_bound_rejects"] = np.asarray(hub.bound_rejects)
+    payload["wheel_corrupt_reads"] = np.asarray(
+        getattr(hub, "corrupt_reads", np.zeros(len(hub.spokes), np.int64)))
+    for j, sp in enumerate(hub.spokes):
+        failed = bool(getattr(sp, "_failed", False))
+        payload[f"spoke{j}_failed"] = np.int64(failed)
+        if failed:
+            continue                   # dead slices restart fresh on resume
+        for k, v in sp.algo_state().items():
+            payload[f"spoke{j}_{k}"] = np.asarray(v)
+        pair = hub.pairs[j]
+        data, wid = pair.to_spoke.read()
+        payload[f"pair{j}_to_spoke"] = np.asarray(data)
+        payload[f"pair{j}_to_spoke_id"] = np.int64(wid)
+        data, wid = pair.to_hub.read()
+        payload[f"pair{j}_to_hub"] = np.asarray(data)
+        payload[f"pair{j}_to_hub_id"] = np.int64(wid)
+    return _atomic_savez(path, payload)
+
+
+def load_wheel_ensemble(path, hub):
+    """Install the ensemble half of a wheel checkpoint into a wired
+    hub (pairs and spokes constructed, setup_hub done).  The hub
+    optimizer's PH state is NOT touched here — it rides the normal
+    `resume_from` -> load_run_checkpoint path, which reads the same
+    file.  Spokes saved as failed are skipped: they restart fresh.
+    Window payloads are re-posted under their saved write_ids, so
+    freshness comparisons continue exactly where the saved spin
+    stopped."""
+    z = np.load(_norm_npz(path), allow_pickle=True)
+    if "wheel_format" not in z:
+        raise ValueError(
+            f"{path} is a plain PH run checkpoint, not a wheel "
+            "ensemble (it restores the hub only)")
+    n = int(z["wheel_n_spokes"])
+    if n != len(hub.spokes):
+        raise ValueError(
+            f"wheel checkpoint has {n} spokes, this wheel has "
+            f"{len(hub.spokes)}")
+    hub._spoke_read_ids[:] = np.asarray(z["wheel_spoke_read_ids"])
+    hub.bound_rejects[:] = np.asarray(z["wheel_bound_rejects"])
+    if hasattr(hub, "corrupt_reads") and "wheel_corrupt_reads" in z:
+        hub.corrupt_reads[:] = np.asarray(z["wheel_corrupt_reads"])
+    for j, sp in enumerate(hub.spokes):
+        if int(z[f"spoke{j}_failed"]):
+            continue
+        prefix = f"spoke{j}_"
+        state = {k[len(prefix):]: z[k] for k in z.files
+                 if k.startswith(prefix) and k != f"spoke{j}_failed"}
+        sp.restore_algo_state(state)
+        pair = hub.pairs[j]
+        for win, key in ((pair.to_spoke, f"pair{j}_to_spoke"),
+                         (pair.to_hub, f"pair{j}_to_hub")):
+            wid = int(z[key + "_id"])
+            data = np.asarray(z[key])
+            # shape guard: a resume under a different plan can carry a
+            # different padded length — skip the re-post and let the
+            # next superstep publish fresh vectors
+            if wid > 0 and data.shape == (win.length,):
+                win.write(data, write_id=wid)
+    return z
+
+
+# -- serve drain checkpoints (serve/service.py, PR 10) --------------------
+
+def save_drain_checkpoint(path, requests):
+    """Atomically persist the requests a draining SolverService could
+    not finish: a list of plain dicts (id, options, scenario_names,
+    model, batch with HOST-numpy leaves — the caller converts; device
+    buffers do not pickle).  A restarted service warms from this file
+    and resubmits them."""
+    payload = {
+        "drain_format": np.int64(1),
+        "requests": np.array(list(requests), dtype=object),
+    }
+    return _atomic_savez(path, payload)
+
+
+def load_drain_checkpoint(path):
+    """The saved request dicts, in submission order."""
+    z = np.load(_norm_npz(path), allow_pickle=True)
+    if "drain_format" not in z:
+        raise ValueError(
+            f"{path} is not a drain checkpoint")
+    return list(np.asarray(z["requests"], dtype=object))

@@ -95,6 +95,7 @@ class SolverService:
         self._ids = itertools.count(1)
         self._dispatches = 0
         self._stopped = False
+        self._draining = False        # drain(): admission closed
         self._failed = None           # terminal service failure reason
         self.restarts = 0
         self._worker = None
@@ -140,6 +141,73 @@ class SolverService:
                 self._finish_locked(req, rejected_result(req.id, "shutdown"))
             self._queue.clear()
 
+    def drain(self, deadline=30.0, checkpoint_path=None):
+        """Graceful drain: close admission immediately (submits reject
+        with reason "draining"), let the worker flush the queue and
+        in-flight work for up to `deadline` seconds, then stop it and
+        checkpoint whatever could not finish (resilience/checkpoint.py
+        drain format) so a restarted service `warm_from()`s the file
+        and resubmits.  Leftover requests get a structured
+        rejected("drained") result — never a hang."""
+        with self._work:
+            self._draining = True
+            self._work.notify_all()
+        self._tel.event("serve.drain", deadline=deadline)
+        end = time.monotonic() + float(deadline)
+        while time.monotonic() < end:
+            with self._lock:
+                if not self._queue and not self._inflight:
+                    break
+            time.sleep(0.02)
+        with self._work:
+            self._stopped = True
+            self._work.notify_all()
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(max(0.0, end - time.monotonic()) + 1.0)
+        with self._lock:
+            leftovers = list(self._queue) + list(self._inflight)
+            self._queue.clear()
+        saved = None
+        if checkpoint_path is not None and leftovers:
+            import jax
+            import numpy as np
+
+            from ..resilience.checkpoint import save_drain_checkpoint
+            saved = save_drain_checkpoint(checkpoint_path, [
+                {"id": req.id, "options": dict(req.options),
+                 "scenario_names": req.scenario_names,
+                 "model": req.model,
+                 # device buffers do not pickle: host-round-trip leaves
+                 "batch": jax.tree_util.tree_map(np.asarray, req.batch)}
+                for req in leftovers])
+            global_toc(f"serve: drained {len(leftovers)} request(s) to "
+                       f"{saved}")
+        for req in leftovers:
+            self._finish(req, rejected_result(req.id, "drained"))
+        self._tel.event("serve.drained", leftovers=len(leftovers),
+                        checkpoint=str(saved))
+        return {"drained": len(leftovers), "checkpoint": saved}
+
+    def warm_from(self, path):
+        """Resubmit the requests a previous incarnation drained to
+        `path` (in their original submission order).  Returns a list of
+        (saved_request_id, RequestHandle) pairs; saved deadlines are
+        NOT carried over (absolute monotonic clocks do not survive a
+        restart)."""
+        from ..resilience.checkpoint import load_drain_checkpoint
+        saved = load_drain_checkpoint(path)
+        self.start()
+        handles = []
+        for d in saved:
+            h = self.submit(d["batch"], options=d["options"],
+                            scenario_names=d["scenario_names"],
+                            model=d["model"])
+            handles.append((d["id"], h))
+        self._tel.event("serve.warm_from", path=str(path),
+                        requests=len(handles))
+        return handles
+
     # -- client API -------------------------------------------------------
     def submit(self, batch, options=None, scenario_names=None,
                deadline=None, model=None):
@@ -162,6 +230,8 @@ class SolverService:
                 reason = "service_failed"
             elif self._stopped:
                 reason = "shutdown"
+            elif self._draining:
+                reason = "draining"
             elif len(self._queue) >= self.max_queue:
                 reason = "queue_full"
             elif len(self._queue) + self._processing >= self.max_inflight:

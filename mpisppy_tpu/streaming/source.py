@@ -164,6 +164,71 @@ class BatchSource(ScenarioSource):
         return [names[int(i)] for i in np.asarray(indices)]
 
 
+class SourceBuildError(RuntimeError):
+    """A scenario block could not be built within the retry budget.
+    Carries the structured failure context (the index set, attempt
+    count, and the last underlying error) so drivers can log/requeue
+    the block instead of parsing a message string."""
+
+    def __init__(self, message, indices=None, attempts=0, last_error=None):
+        super().__init__(message)
+        self.indices = (tuple(int(i) for i in np.asarray(indices).ravel())
+                        if indices is not None else ())
+        self.attempts = int(attempts)
+        self.last_error = last_error
+
+
+class RetryingSource(ScenarioSource):
+    """Retry-with-capped-backoff wrapper for transient block build
+    failures (a flaky scenario store, an injected chaos fault).  Blocks
+    are pure functions of their index set, so a retry is always safe;
+    after `retries` failed re-attempts the structured SourceBuildError
+    surfaces.  StreamingPH wires this automatically when the options
+    carry `source_retries` (with `source_backoff`/`source_backoff_cap`
+    shaping the delay like the supervisor's restart ladder)."""
+
+    def __init__(self, source, retries=2, backoff=0.05, backoff_cap=5.0,
+                 chaos=None):
+        self.inner = source
+        self.name = source.name
+        self.total_scens = int(source.total_scens)
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+        self.backoff_cap = float(backoff_cap)
+        self.chaos = chaos             # block_build_fail injection point
+        self.retry_log = []
+
+    def block(self, indices):
+        import time
+
+        from ..resilience.supervisor import restart_delay
+
+        last = None
+        for attempt in range(1, self.retries + 2):
+            try:
+                if self.chaos is not None:
+                    self.chaos.block_build_tick()
+                return self.inner.block(indices)
+            except Exception as e:
+                last = e
+                if attempt > self.retries:
+                    break
+                delay = restart_delay(attempt, self.backoff,
+                                      self.backoff_cap)
+                self.retry_log.append(
+                    {"attempt": attempt, "error": str(e),
+                     "delay": delay})
+                time.sleep(delay)
+        raise SourceBuildError(
+            f"scenario block build failed after {self.retries} "
+            f"retr{'y' if self.retries == 1 else 'ies'}: {last}",
+            indices=indices, attempts=self.retries + 1,
+            last_error=last)
+
+    def names(self, indices):
+        return self.inner.names(indices)
+
+
 def source_for_module(module, num_scens, cfg=None):
     """Build a ScenarioSource for a model module: the module's own
     `scenario_source(num_scens, cfg)` hook when it has one (farmer, uc,

@@ -91,6 +91,18 @@ class StreamingPH(PHBase):
         # the certified rule is the stopping criterion; PH's consensus
         # threshold would otherwise end the loop uncertified
         o.setdefault("convthresh", 0.0)
+        # transient-build resilience: source_retries wraps the source
+        # in a capped-backoff retry loop (resilience/supervisor ladder)
+        # before ANY block builds — the template block included
+        retries = int(o.get("source_retries", 0))
+        if retries > 0:
+            from ..resilience.chaos import ChaosInjector
+            from .source import RetryingSource
+            source = RetryingSource(
+                source, retries=retries,
+                backoff=float(o.get("source_backoff", 0.05)),
+                backoff_cap=float(o.get("source_backoff_cap", 5.0)),
+                chaos=ChaosInjector.from_options(o.get("chaos")))
         self.source = source
         self.module = module
         self.total_scens = int(source.total_scens)

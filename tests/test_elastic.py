@@ -1,0 +1,432 @@
+"""Elastic fault-domain tests (PR 10): dynamic re-slicing after a
+slice dies, wheel-level ensemble checkpoint/resume, integrity-guarded
+window reads, the new slice-granular chaos modes, the supervisor's
+per-thread shutdown shares, and the resilience<-/->mpmd layering guard.
+
+Every failure is injected deterministically through
+mpisppy_tpu/resilience/chaos.py; the end-to-end reslice tests run the
+wheel in LOCKSTEP mode so the kill -> prune -> reslice -> recover
+sequence lands on exact iterations (no thread-scheduling slack).
+"""
+
+import ast
+import os
+import time
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+from efcheck import ef_linprog
+from mpisppy_tpu import telemetry
+from mpisppy_tpu.cylinders.spcommunicator import Window
+from mpisppy_tpu.models import farmer
+from mpisppy_tpu.mpmd import CylinderSlice, MPMDWheel, SlicePlan
+from mpisppy_tpu.mpmd.reslice import ReslicePlanner
+from mpisppy_tpu.mpmd.wheel import SliceSupervisor
+from mpisppy_tpu.resilience.bounds import PayloadGuard, payload_checksum
+from mpisppy_tpu.resilience.chaos import (ChaosError, ChaosInjector,
+                                          DeviceLossError)
+from mpisppy_tpu.resilience.checkpoint import (
+    is_wheel_checkpoint, load_drain_checkpoint, load_wheel_ensemble,
+    save_drain_checkpoint, save_run_checkpoint)
+
+from test_mpmd_wheel import (OPTS, S, RecordingHub, farmer_dicts,
+                             fresh_telemetry)  # noqa: F401
+
+pytestmark = pytest.mark.mpmd
+
+PKG_ROOT = os.path.join(os.path.dirname(__file__), "..", "mpisppy_tpu")
+
+
+# ---- integrity-guarded exchange (unit) -----------------------------------
+
+class TestPayloadGuard:
+    def test_checksum_mismatch_rejected(self):
+        w = Window(3)
+        w.write(np.arange(3.0))
+        data, wid, ok, reason = w.read_checked()
+        assert ok and wid == 1 and np.array_equal(data, np.arange(3.0))
+        # chaos corrupt_window: perturbed payload under the TRUE
+        # checksum — only payload validation can catch it
+        w.corrupt_next_write()
+        w.write(np.arange(3.0))
+        data, wid, ok, reason = w.read_checked()
+        assert not ok and "checksum mismatch" in reason
+        assert wid == 2
+        # an honest re-post clears the fault
+        w.write(np.arange(3.0))
+        assert w.read_checked()[2]
+
+    def test_write_id_regression_rejected(self):
+        g = PayloadGuard()
+        v = np.ones(2)
+        assert g.check(v, 5, payload_checksum(v))[0]
+        ok, reason = g.check(v, 3, payload_checksum(v))
+        assert not ok and "regressed" in reason
+        assert g.corrupt == 1
+        # same id again is NOT a regression (stale, but intact)
+        assert g.check(v, 5, payload_checksum(v))[0]
+
+    def test_kill_id_exempt(self):
+        g = PayloadGuard()
+        assert g.check(np.zeros(2), 9, payload_checksum(np.zeros(2)))[0]
+        ok, _ = g.check(np.zeros(2), Window.KILL, None)
+        assert ok                      # -1 carries no payload
+
+
+# ---- slice-granular chaos modes (unit) -----------------------------------
+
+class TestChaosModes:
+    def test_device_loss_raises_and_is_chaos_error(self):
+        c = ChaosInjector({"device_loss": 2})
+        c.step_tick()
+        with pytest.raises(DeviceLossError):
+            c.step_tick()
+        assert issubclass(DeviceLossError, ChaosError)
+
+    def test_write_fate_corrupt_from_nth_write(self):
+        c = ChaosInjector({"corrupt_window": 3})
+        assert [c.write_fate() for _ in range(4)] == \
+            ["ok", "ok", "corrupt", "corrupt"]
+
+    def test_write_fate_partition_drops(self):
+        c = ChaosInjector({"partition_slice": 2})
+        assert [c.write_fate() for _ in range(3)] == \
+            ["ok", "drop", "drop"]
+
+    def test_block_build_fail_budget(self):
+        c = ChaosInjector({"block_build_fail": 2})
+        for _ in range(2):
+            with pytest.raises(ChaosError, match="block build failure"):
+                c.block_build_tick()
+        c.block_build_tick()           # third build passes
+        assert ChaosInjector().write_fate() == "ok"   # inert injector
+
+
+# ---- ReslicePlanner (unit, jax-free device bookkeeping) ------------------
+
+def _plan(spec):
+    """spec: [(name, ndev), ...] with string stand-in devices."""
+    slices, n = [], 0
+    for i, (name, nd) in enumerate(spec):
+        slices.append(CylinderSlice(
+            name, i, tuple(f"d{n + j}" for j in range(nd))))
+        n += nd
+    return SlicePlan(slices)
+
+
+class TestReslicePlanner:
+    def test_hub_target_appends_reclaimed(self):
+        plan = _plan([("hub", 6), ("lag", 1), ("xhat", 1)])
+        dead = plan.spokes[0]
+        new, reclaimed = ReslicePlanner().successor(plan, dead)
+        assert reclaimed == ("d6",)
+        assert new.n_slices == 2
+        # hub keeps its first device (to_hub mailboxes live there) and
+        # the dead slice's devices APPEND after the existing ones
+        assert new.hub.devices[0] == "d0"
+        assert new.hub.devices == tuple(f"d{i}" for i in range(7))
+        assert new.spokes[0] is plan.spokes[1]   # survivor untouched
+        assert new.pad_multiple() == 7           # lcm(7, 1)
+
+    def test_starved_target_grows_smallest_spoke(self):
+        plan = _plan([("hub", 4), ("big", 2), ("small", 1), ("dead", 1)])
+        new, reclaimed = ReslicePlanner(target="starved").successor(
+            plan, plan.spokes[2])
+        assert reclaimed == ("d7",)
+        grown = [s for s in new.spokes if s.name == "small"][0]
+        assert grown.devices == ("d6", "d7")
+        assert new.hub.n_devices == 4            # hub untouched
+
+    def test_hub_cannot_die(self):
+        plan = _plan([("hub", 2), ("lag", 1)])
+        with pytest.raises(ValueError, match="cannot be resliced"):
+            ReslicePlanner().successor(plan, plan.hub)
+
+    def test_foreign_slice_rejected(self):
+        plan = _plan([("hub", 2), ("lag", 1)])
+        foreign = CylinderSlice("ghost", 9, ("z0",))
+        with pytest.raises(ValueError, match="not part of this plan"):
+            ReslicePlanner().successor(plan, foreign)
+
+    def test_equality_fallback_for_roundtripped_slices(self):
+        plan = _plan([("hub", 2), ("lag", 1)])
+        # an equal-but-not-identical CylinderSlice (a plan rebuilt from
+        # describe()) still matches
+        twin = CylinderSlice("lag", 1, ("d2",))
+        new, reclaimed = ReslicePlanner().successor(plan, twin)
+        assert reclaimed == ("d2",) and new.n_slices == 1
+        with pytest.raises(ValueError, match="'hub' or 'starved'"):
+            ReslicePlanner(target="bogus")
+
+
+# ---- end-to-end: kill -> reslice -> recover ------------------------------
+
+@pytest.mark.chaos
+class TestResliceEndToEnd:
+    def test_device_loss_returns_devices_to_hub(self, fresh_telemetry):
+        """A chaos device loss on the Lagrangian slice prunes the spoke
+        (unrestartable), the next sync's reslice barrier returns its
+        device to the hub (8-device fleet: hub 6 -> 7, pad 6 -> 7), and
+        the wheel finishes with the same certified verdict as the
+        failure-free run."""
+        # failure-free reference on the identical lockstep schedule
+        hub_dict, spoke_dicts = farmer_dicts(hub_class=RecordingHub)
+        ref = MPMDWheel(hub_dict, spoke_dicts, lockstep=True)
+        ref.spin()
+
+        hub_dict, spoke_dicts = farmer_dicts(
+            hub_class=RecordingHub,
+            spoke_chaos={"device_loss": 1})
+        ws = MPMDWheel(hub_dict, spoke_dicts, lockstep=True)
+        ws.spin()
+        sup = ws.supervisor
+        hub = ws.spcomm
+
+        # pruned through the standard path, resliced within 2 supersteps
+        assert len(hub.failed_spokes) == 1
+        assert hub.failed_spokes[0][0] == "LagrangianOuterBound"
+        assert "injected device loss" in hub.failed_spokes[0][1]
+        assert len(sup.reslice_log) == 1
+        ev = sup.reslice_log[0]
+        assert ev["name"] == "spoke0"
+        assert ev["devices_reclaimed"] == 1
+        assert ev["hub_devices"] == 7            # 6 + the dead slice's 1
+        assert ev["padded_scens"] == 7           # lcm(7, 1) re-pad
+        assert ev["iteration"] <= 3              # within 2 supersteps
+        assert sup.devices_reclaimed == 1
+        # the hub really reshards: batch + mesh now span 7 devices
+        assert ws.spcomm.opt.batch.num_scens == 7
+        assert ws.spcomm.opt.mesh.size == 7
+        # plan bookkeeping follows (health keeps per-spoke devices)
+        assert ws.supervisor.plan.hub.n_devices == 7
+        assert sup.health()[0]["devices"] == []  # dead slice emptied
+
+        # certified verdict parity with the failure-free run: the
+        # surviving xhat slice certifies the same incumbent (a reslice
+        # changes summation shapes by a zero-probability pad row, so
+        # this is rtol parity, not bit parity)
+        opt_val = ef_linprog(farmer.build_batch(S))[0]
+        for run in (ref, ws):
+            assert run.BestOuterBound <= opt_val + 1.0
+            assert run.BestInnerBound >= opt_val - 1.0
+        assert ws.BestInnerBound == pytest.approx(
+            ref.BestInnerBound, rel=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ws.best_nonant_solution()),
+            np.asarray(ref.best_nonant_solution()), rtol=1e-5)
+
+        # counters the bench JSON reads
+        c = telemetry.wheel_counters()
+        assert c["wheel_reslice_events"] == 1
+        assert c["wheel_devices_reclaimed"] == 1
+        assert c["wheel_n_slices"] == 2
+
+
+@pytest.mark.chaos
+class TestCorruptWindowPrune:
+    def test_corrupt_writes_counted_then_pruned(self, fresh_telemetry):
+        """corrupt_window chaos flips every posted payload under an
+        honest checksum: the hub's read_checked rejects each snapshot,
+        the per-spoke corrupt-read counter climbs to the budget, the
+        spoke is pruned like a crashed one — and the reslice barrier
+        reclaims its device."""
+        hub_dict, spoke_dicts = farmer_dicts(
+            spoke_chaos={"corrupt_window": 1},
+            opt_overrides={"PHIterLimit": 12},
+            hub_opts={"max_corrupt_reads": 3})
+        ws = MPMDWheel(hub_dict, spoke_dicts, lockstep=True)
+        ws.spin()
+        hub = ws.spcomm
+        assert int(hub.corrupt_reads[0]) >= 3
+        assert len(hub.failed_spokes) == 1
+        assert "corrupt window reads" in hub.failed_spokes[0][1]
+        # the poison never reached the bound state
+        assert np.isfinite(ws.BestInnerBound)
+        assert np.isfinite(ws.BestOuterBound)
+        # prune feeds the same elastic path as a crash
+        assert len(ws.supervisor.reslice_log) == 1
+        c = telemetry.wheel_counters()
+        assert c["wheel_corrupt_reads"] >= 3
+        assert c["wheel_reslice_events"] == 1
+
+
+# ---- wheel-level ensemble checkpoint / resume ----------------------------
+
+@pytest.mark.chaos
+class TestWheelEnsembleCheckpoint:
+    def test_mid_spin_resume_replays_bit_equally(self, tmp_path,
+                                                 fresh_telemetry):
+        """Run A: uninterrupted lockstep spin.  Run B: identical run
+        capped at iter 4, writing the ensemble checkpoint at the end of
+        every sync.  Run C: MPMDWheel(resume_from=B's file) — the hub's
+        PH state, every spoke's algorithm state, and the window
+        payloads come back, so C's bound trajectory is the BIT-EQUAL
+        tail of A's."""
+        hub_dict, spoke_dicts = farmer_dicts(hub_class=RecordingHub)
+        a = MPMDWheel(hub_dict, spoke_dicts, lockstep=True)
+        a.spin()
+        trace_a = np.array(a.spcomm.bound_trace)
+
+        ck = os.fspath(tmp_path / "wheel_ensemble")
+        hub_dict, spoke_dicts = farmer_dicts(
+            opt_overrides={"PHIterLimit": 4},
+            hub_opts={"wheel_checkpoint": ck})
+        b = MPMDWheel(hub_dict, spoke_dicts, lockstep=True)
+        b.spin()
+        assert is_wheel_checkpoint(ck)
+        with np.load(ck + ".npz", allow_pickle=True) as z:
+            assert int(z["it"]) == 4
+            assert int(z["wheel_n_spokes"]) == 2
+            import json
+            plan = json.loads(str(z["wheel_plan"]))
+            assert plan[0]["name"] == "hub" and len(plan) == 3
+            assert not os.path.exists(ck + ".npz.tmp")   # atomic
+        # a wheel with a different spoke count must refuse the file
+        with pytest.raises(ValueError, match="spokes"):
+            load_wheel_ensemble(ck, types.SimpleNamespace(spokes=[None]))
+
+        hub_dict, spoke_dicts = farmer_dicts(hub_class=RecordingHub)
+        c = MPMDWheel(hub_dict, spoke_dicts, lockstep=True,
+                      resume_from=ck)
+        c.spin()
+        trace_c = np.array(c.spcomm.bound_trace)
+
+        # C ran only iterations 5.. — its trajectory is A's tail,
+        # bitwise (np.array_equal, no tolerance)
+        assert 0 < len(trace_c) < len(trace_a)
+        assert np.array_equal(trace_c, trace_a[-len(trace_c):])
+        assert c.BestOuterBound == a.BestOuterBound
+        assert c.BestInnerBound == a.BestInnerBound
+        np.testing.assert_array_equal(
+            np.asarray(c.best_nonant_solution()),
+            np.asarray(a.best_nonant_solution()))
+
+    def test_plain_run_checkpoint_still_resumes_hub(self, tmp_path):
+        """Back-compat both directions: a pre-PR-10 PLAIN run
+        checkpoint is a valid MPMDWheel resume_from (hub restores,
+        spokes start fresh), and load_wheel_ensemble refuses it with a
+        pointed error instead of a KeyError."""
+        from mpisppy_tpu.opt.ph import PH
+        from mpisppy_tpu.parallel.mesh import ScenarioMesh
+        ck = os.fspath(tmp_path / "plain_run")
+        names = [f"scen{i}" for i in range(S)]
+        # same 6-device mesh the wheel hub gets, so the padded S (and
+        # with it the checkpoint's W shape) matches on resume
+        ph = PH(dict(OPTS, PHIterLimit=3), names,
+                batch=farmer.build_batch(S),
+                mesh=ScenarioMesh(devices=jax.devices()[:6]))
+        ph.ph_main(finalize=False)
+        save_run_checkpoint(ck, ph)
+        assert not is_wheel_checkpoint(ck)
+        with pytest.raises(ValueError, match="plain PH run checkpoint"):
+            load_wheel_ensemble(ck, types.SimpleNamespace(spokes=[]))
+        # the wheel still consumes it: hub-only restore, full spin
+        hub_dict, spoke_dicts = farmer_dicts()
+        ws = MPMDWheel(hub_dict, spoke_dicts, lockstep=True,
+                       resume_from=ck)
+        ws.spin()
+        assert np.isfinite(ws.BestInnerBound)
+        # the restored state really seeded the loop: iteration count
+        # moved past the checkpoint's it=3 (gap termination may stop
+        # it anywhere after that)
+        assert int(ws.spcomm.opt.state.it) > 3
+
+    def test_is_wheel_checkpoint_missing_file(self, tmp_path):
+        assert not is_wheel_checkpoint(os.fspath(tmp_path / "absent"))
+
+
+# ---- drain checkpoint round-trip (serve satellite's file format) ---------
+
+class TestDrainCheckpoint:
+    def test_roundtrip_preserves_order_and_payload(self, tmp_path):
+        p = os.fspath(tmp_path / "drainfile")
+        reqs = [{"id": 3, "options": {"PHIterLimit": 4},
+                 "scenario_names": ["a", "b"], "model": "farmer",
+                 "batch": {"c": np.arange(3.0)}},
+                {"id": 7, "options": {}, "scenario_names": None,
+                 "model": None, "batch": {"c": np.zeros(2)}}]
+        real = save_drain_checkpoint(p, reqs)
+        assert real.endswith(".npz") and not os.path.exists(real + ".tmp")
+        back = load_drain_checkpoint(p)
+        assert [d["id"] for d in back] == [3, 7]
+        assert back[0]["options"] == {"PHIterLimit": 4}
+        np.testing.assert_array_equal(back[0]["batch"]["c"],
+                                      np.arange(3.0))
+
+    def test_rejects_foreign_npz(self, tmp_path):
+        p = os.fspath(tmp_path / "notdrain.npz")
+        np.savez(p, W=np.zeros(3))
+        with pytest.raises(ValueError, match="not a drain checkpoint"):
+            load_drain_checkpoint(p)
+
+
+# ---- shutdown joins with per-thread shares -------------------------------
+
+class _HungSpoke:
+    """Stub spoke whose main() never returns (daemon thread leaks with
+    the process, exactly the case shutdown() must bound)."""
+
+    options = {}
+    pair = None
+    _failed = False
+
+    def timed_step(self):
+        return False
+
+    def got_kill_signal(self):
+        return True
+
+    def main(self):                    # pragma: no cover - hung on purpose
+        while True:
+            time.sleep(0.05)
+
+
+class TestShutdownShares:
+    def test_hung_threads_split_the_global_budget(self):
+        reports = []
+        hub = types.SimpleNamespace(
+            options={}, telemetry=None,
+            report_spoke_failure=lambda sp, exc: reports.append(exc))
+        spokes = [_HungSpoke(), _HungSpoke()]
+        plan = types.SimpleNamespace(spokes=["s1", "s2"])
+        sup = SliceSupervisor(hub, spokes, plan)
+        sup.start()
+        t0 = time.monotonic()
+        sup.shutdown(timeout=0.6)
+        elapsed = time.monotonic() - t0
+        # the first hung thread consumed only ITS share — both got
+        # joined within one global budget, not 2 x 0.6s serially
+        assert elapsed < 1.5
+        assert len(reports) == 2
+        assert all(isinstance(e, TimeoutError) for e in reports)
+        assert all("share" in str(e) for e in reports)
+
+
+# ---- layering guard: resilience/ never imports mpmd ----------------------
+
+class TestResilienceLayering:
+    def test_resilience_never_imports_mpmd(self):
+        """Mirror of the cylinders<->mpmd rule: resilience/ serves the
+        wheel through generic hub/spoke/window interfaces only — ANY
+        import of mpmd (even lazy) would invert the dependency."""
+        res_dir = os.path.join(PKG_ROOT, "resilience")
+        for fn in sorted(os.listdir(res_dir)):
+            if not fn.endswith(".py"):
+                continue
+            tree = ast.parse(open(os.path.join(res_dir, fn)).read())
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        assert "mpmd" not in a.name.split("."), \
+                            f"resilience/{fn} imports mpmd"
+                elif isinstance(node, ast.ImportFrom):
+                    mod = node.module or ""
+                    assert "mpmd" not in mod.split("."), \
+                        f"resilience/{fn} imports from mpmd"
+                    for a in node.names:
+                        assert a.name != "mpmd", \
+                            f"resilience/{fn} imports mpmd"

@@ -538,7 +538,8 @@ class TestImportLayering:
                             f"cylinders/{fn} imports mpmd"
 
     @pytest.mark.parametrize("fn", ["__init__.py", "exchange.py",
-                                    "slice_plan.py", "wheel.py"])
+                                    "reslice.py", "slice_plan.py",
+                                    "wheel.py"])
     def test_mpmd_keeps_jax_lazy(self, fn):
         roots = _top_level_import_roots(os.path.join(PKG_ROOT, "mpmd", fn))
         for forbidden in ("jax", "ir", "parallel"):

@@ -367,3 +367,58 @@ def test_worker_crash_budget_exhausted_fails_service():
                       timeout=5)
     assert late["status"] == "rejected"
     assert late["reason"] == "service_failed"
+
+
+# -- graceful drain + warm restart (PR 10) --------------------------------
+
+def test_drain_checkpoints_leftovers_and_closes_admission(tmp_path):
+    svc = SolverService()                # worker never started: the
+    h = svc.submit(farmer.build_batch(3), FAST_OPTS)  # request stays queued
+    p = str(tmp_path / "drain")
+    out = svc.drain(deadline=0.3, checkpoint_path=p)
+    assert out["drained"] == 1
+    assert out["checkpoint"] is not None and out["checkpoint"].endswith(".npz")
+    # the leftover got a structured rejection, never a hang
+    res = svc.result(h, timeout=1)
+    assert res["status"] == "rejected" and res["reason"] == "drained"
+    # the saved request round-trips with host-numpy leaves
+    from mpisppy_tpu.resilience.checkpoint import load_drain_checkpoint
+    saved = load_drain_checkpoint(p)
+    assert len(saved) == 1 and saved[0]["options"] == FAST_OPTS
+    assert isinstance(saved[0]["batch"].c, np.ndarray)
+
+
+def test_submit_during_drain_rejects_with_draining():
+    svc = SolverService()
+    with svc._work:
+        svc._draining = True             # admission closed mid-drain
+    res = svc.result(svc.submit(farmer.build_batch(3), FAST_OPTS),
+                     timeout=1)
+    assert res["status"] == "rejected" and res["reason"] == "draining"
+
+
+def test_drain_empty_service_is_a_noop():
+    svc = SolverService()
+    out = svc.drain(deadline=0.1, checkpoint_path=None)
+    assert out == {"drained": 0, "checkpoint": None}
+
+
+def test_warm_from_resubmits_and_solves(tmp_path):
+    """Full drain -> restart cycle: service 1 drains a queued request
+    to disk, a fresh service 2 warms from the file and actually solves
+    it."""
+    p = str(tmp_path / "drain_cycle")
+    svc1 = SolverService()
+    svc1.submit(farmer.build_batch(3), FAST_OPTS)
+    out = svc1.drain(deadline=0.3, checkpoint_path=p)
+    assert out["drained"] == 1
+
+    svc2 = SolverService()
+    try:
+        handles = svc2.warm_from(p)
+        assert [old_id for old_id, _ in handles] == [1]
+        res = svc2.result(handles[0][1], timeout=120)
+        assert res["status"] == "ok"
+        assert np.isfinite(res["conv"])
+    finally:
+        svc2.shutdown()

@@ -354,3 +354,72 @@ def test_streaming_host_modules_never_import_jax_eagerly(mod):
                 f"{mod}: from jax import ..."
             assert node.module != "mpisppy_tpu.streaming.streaming_ph", \
                 f"{mod}: eager import of the jax-backed driver"
+
+
+# ---- retry-with-capped-backoff source wrapper (PR 10) ---------------------
+
+def test_retrying_source_recovers_from_transient_failures():
+    from mpisppy_tpu.resilience.chaos import ChaosInjector
+    from mpisppy_tpu.streaming.source import RetryingSource
+
+    src = RetryingSource(
+        BatchSource(farmer.build_batch(8)), retries=2,
+        backoff=0.001, backoff_cap=0.002,
+        chaos=ChaosInjector({"block_build_fail": 2}))
+    b = src.block(np.arange(3))          # fails twice, succeeds third
+    assert b.num_scens == 3
+    assert len(src.retry_log) == 2
+    assert [r["attempt"] for r in src.retry_log] == [1, 2]
+    assert all("block build failure" in r["error"]
+               for r in src.retry_log)
+    assert all(r["delay"] <= 0.002 for r in src.retry_log)  # capped
+    # names delegate to the inner source untouched
+    assert src.names([0]) == ["scen0"]
+    assert src.total_scens == 8
+
+
+def test_retrying_source_exhaustion_is_structured():
+    from mpisppy_tpu.resilience.chaos import ChaosError, ChaosInjector
+    from mpisppy_tpu.streaming.source import (RetryingSource,
+                                              SourceBuildError)
+
+    src = RetryingSource(
+        BatchSource(farmer.build_batch(8)), retries=1,
+        backoff=0.001, backoff_cap=0.002,
+        chaos=ChaosInjector({"block_build_fail": 5}))
+    with pytest.raises(SourceBuildError,
+                       match="failed after 1 retry") as ei:
+        src.block(np.arange(3))
+    e = ei.value
+    assert e.attempts == 2               # first try + one retry
+    assert e.indices == (0, 1, 2)
+    assert isinstance(e.last_error, ChaosError)
+    assert len(src.retry_log) == 1       # the final attempt is not a retry
+
+
+def test_retrying_source_wraps_non_chaos_errors_too():
+    from mpisppy_tpu.streaming.source import (RetryingSource,
+                                              SourceBuildError)
+
+    src = RetryingSource(BatchSource(farmer.build_batch(4)), retries=0,
+                         backoff=0.001)
+    with pytest.raises(SourceBuildError, match="failed after 0 retries"):
+        src.block(np.array([99]))        # IndexError inside, wrapped
+    assert src.retry_log == []
+
+
+def test_streaming_ph_wires_source_retries_from_options():
+    """source_retries>0 wraps the source BEFORE the template block
+    build, so even the constructor-time build survives a transient
+    fault — and the run completes normally afterwards."""
+    from mpisppy_tpu.streaming.source import RetryingSource
+
+    sph = StreamingPH(
+        _stream_opts(PHIterLimit=2, source_retries=2,
+                     source_backoff=0.001, source_backoff_cap=0.002,
+                     chaos={"block_build_fail": 1}),
+        BatchSource(farmer.build_batch(24)), module=None)
+    assert isinstance(sph.source, RetryingSource)
+    assert len(sph.source.retry_log) >= 1   # the template build retried
+    sph.stream_main(finalize=False)
+    assert np.isfinite(sph.conv)
