@@ -473,13 +473,77 @@ def worker_uc():
         **_telemetry_extras(ph)}))
 
 
+def _serve_chaos_row(opts, S, dtype):
+    """Chaos-on replica-set phase of the serve bench: a 2-replica
+    Router under replica_crash + slow_replica + poison_request with an
+    open request load.  Returns the resilience fields for the serve
+    JSON row — p50/p99 latency, hedge/shed traffic, breaker opens and
+    replica restarts — so the bench records what degradation under
+    chaos actually costs, not just the sunny-day throughput."""
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.serve.router import Router
+
+    n_req = int(os.environ.get("BENCH_SERVE_CHAOS_REQUESTS", 8))
+    router = Router({
+        "serve_replicas": 2,
+        "serve_max_batch": 1,
+        "serve_restart_backoff": 0.01,
+        "serve_restart_backoff_cap": 0.05,
+        "router_tick": 0.01, "router_probe_interval": 0.02,
+        "router_hedge_threshold": 1.0,
+        "router_breaker_backoff": 0.05,
+        "router_breaker_backoff_cap": 0.5,
+        "router_drain_deadline": 0.3,
+        "chaos": {"replica_crash": 1, "slow_replica": 0.02,
+                  "poison_request": True, "chaos_replica": 0},
+    }).start()
+    try:
+        batch = farmer.build_batch(S, dtype=dtype)
+        handles = []
+        for i in range(n_req):
+            handles.append(router.submit(
+                batch, opts, model="farmer",
+                idempotency_key=f"bench{i}"))
+            if i == n_req // 2:      # poison mid-stream
+                handles.append(router.submit(
+                    batch, dict(opts, chaos_poison=True),
+                    model="farmer", idempotency_key="bench-poison"))
+            time.sleep(0.05)
+        results = [router.result(h, timeout=600) for h in handles]
+        st = router.stats()
+        counts = st["counts"]
+        return {
+            "chaos": "replica_crash+slow_replica+poison_request",
+            "chaos_requests": len(handles),
+            "chaos_ok": sum(r["status"] == "ok" for r in results),
+            "chaos_quarantined": counts.get("quarantined", 0),
+            "p50_latency_seconds": (round(st["p50"], 4)
+                                    if st["p50"] is not None else -1),
+            "p99_latency_seconds": (round(st["p99"], 4)
+                                    if st["p99"] is not None else -1),
+            "hedged_requests": counts.get("hedged_requests", 0),
+            "shed_requests": (counts.get("shed_requests", 0)
+                              + counts.get("shed_hedges", 0)),
+            "breaker_opens": counts.get("breaker_opens", 0),
+            "replica_restarts": st["replica_restarts"],
+            "brownout_level_max": max(
+                [lv for lv, _ in router.brownout_transitions],
+                default=0),
+        }
+    finally:
+        router.shutdown(timeout=10)
+
+
 def worker_serve():
     """BENCH_MODEL=serve: SolverService throughput on concurrent
     same-bucket farmer requests (mpisppy_tpu/serve/) — the serving
     shape the ROADMAP north star needs numbers for.  Emits
     `serve_throughput_req_per_sec` and `compile_cache_hit_rate`
     alongside the standard metric fields; there is no reference
-    comparator, so vs_baseline is 0."""
+    comparator, so vs_baseline is 0.  Unless BENCH_SERVE_CHAOS=0, a
+    second chaos-on phase runs the replica-set Router under injected
+    replica_crash/slow_replica/poison_request and merges its
+    latency-percentile and resilience counters into the same row."""
     import numpy as np
 
     from mpisppy_tpu.utils.platform import (enable_f64_if_cpu,
@@ -525,6 +589,8 @@ def worker_serve():
         **counters}
     if ok != n_req:
         out["note"] = f"{n_req - ok} request(s) not ok"
+    if os.environ.get("BENCH_SERVE_CHAOS", "1") != "0":
+        out.update(_serve_chaos_row(opts, S, dtype))
     print(json.dumps(out))
 
 

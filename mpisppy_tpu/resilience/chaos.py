@@ -71,6 +71,20 @@ class ChaosInjector:
                            and hang pruning fires)
       block_build_fail: int streaming: fail the first N source block
                            builds (retry/backoff tests)
+      replica_crash: int   serve replica: raise ChaosError on EVERY
+                           dispatch from the N-th on (exhausts the
+                           service's worker-restart budget so the
+                           whole replica fails closed — the router's
+                           replace-and-replay path)
+      slow_replica: float  serve replica: sleep this many seconds
+                           before every dispatch (injected dispatch
+                           latency — the hedged-retry trigger)
+      poison_request: bool serve: a request whose options carry
+                           `chaos_poison` crashes whichever replica
+                           dispatches it (deterministically, every
+                           time) — the router's poison budget must
+                           quarantine it instead of hedge-amplifying
+                           the crash across the replica set
     """
 
     HARD_EXIT_CODE = 13
@@ -121,6 +135,29 @@ class ChaosInjector:
                 os._exit(self.HARD_EXIT_CODE)
             raise ChaosError(
                 f"injected spoke crash at step {self.steps}")
+        if c.get("replica_crash") and self.steps >= int(c["replica_crash"]):
+            raise ChaosError(
+                f"injected replica crash at dispatch {self.steps}")
+
+    # -- serve-side -------------------------------------------------------
+    def pre_dispatch(self):
+        """Injected dispatch latency (slow_replica): the serve dispatch
+        thread sleeps before executing each group, so queued requests
+        age past the router's hedge threshold while the replica stays
+        alive and healthy-looking."""
+        d = float(self.config.get("slow_replica", 0) or 0)
+        if d > 0:
+            time.sleep(d)
+
+    def request_tick(self, options):
+        """Poison-request injection: when poison_request is armed, a
+        request whose options carry `chaos_poison` crashes the
+        dispatching worker — every time, on every replica it is
+        (re)tried on.  Only a router-level poison budget stops the
+        blast radius."""
+        if self.config.get("poison_request") \
+                and (options or {}).get("chaos_poison"):
+            raise ChaosError("injected poison request")
 
     def poison(self, values):
         """NaN-poison an outgoing vector (bound hygiene tests)."""

@@ -393,9 +393,18 @@ def save_drain_checkpoint(path, requests):
 
 
 def load_drain_checkpoint(path):
-    """The saved request dicts, in submission order."""
-    z = np.load(_norm_npz(path), allow_pickle=True)
-    if "drain_format" not in z:
+    """The saved request dicts, in submission order.  A truncated or
+    bit-flipped file (np.load / zip / pickle errors) raises ValueError
+    with the underlying cause — SolverService.warm_from turns that
+    into a structured reject instead of propagating mid-resubmit."""
+    try:
+        z = np.load(_norm_npz(path), allow_pickle=True)
+        if "drain_format" not in z:
+            raise ValueError(f"{path} is not a drain checkpoint")
+        return list(np.asarray(z["requests"], dtype=object))
+    except ValueError:
+        raise
+    except Exception as exc:
         raise ValueError(
-            f"{path} is not a drain checkpoint")
-    return list(np.asarray(z["requests"], dtype=object))
+            f"corrupt or truncated drain checkpoint {path}: "
+            f"{exc!r}") from exc

@@ -13,31 +13,49 @@ Modules (doc/src/serve.md is the operator-facing chapter):
     dispatch loop that coalesces same-bucket requests into one
     vmap-batched execution, and SpokeSupervisor-style worker
     supervision (chaos-injectable, capped-backoff restarts);
+  * `replica` — Replica/ReplicaSet: N supervised services as isolated
+    fault domains (own threads, own compile-cache handle, separately
+    drainable), with slot-targeted chaos and replace-and-warm_from;
+  * `router` — the replica-set front door: health-probed circuit
+    breakers, hedged retries made safe by idempotency keys, per-tenant
+    token-bucket quotas, a brownout ladder, and replace-and-replay of
+    requests stranded on a dead replica;
   * `api` — submit/poll/result handles + synchronous solve() over a
-    process-global service;
+    process-global router (serve_replicas=1 by default);
   * `request` — jax-free request/result envelope types.
 
 Importing this package (or `serve.api`) never imports jax; the service
-machinery loads on first use.
+machinery loads on first use.  `router`/`replica` are jax-free too —
+only a replica's SolverService pulls in the backend.
 """
 
-from .api import (RequestHandle, get_service, poll, result,  # noqa: F401
-                  shutdown_service, solve, start_service, submit)
+from .api import (RequestHandle, RouterHandle, get_service,  # noqa: F401
+                  poll, result, shutdown_service, solve,
+                  start_service, submit)
 
 __all__ = [
-    "RequestHandle", "SolverService", "CompileCache", "bucket_key",
-    "get_service", "poll", "result", "shutdown_service", "solve",
-    "start_service", "submit",
+    "RequestHandle", "RouterHandle", "SolverService", "CompileCache",
+    "bucket_key", "Router", "Replica", "ReplicaSet", "CircuitBreaker",
+    "TokenBucket", "get_service", "poll", "result", "shutdown_service",
+    "solve", "start_service", "submit",
 ]
 
 
 def __getattr__(name):
     # lazy heavyweights: SolverService/CompileCache pull in the full
-    # optimizer stack (and jax) — resolved only when actually used
+    # optimizer stack (and jax) — resolved only when actually used.
+    # Router/Replica are themselves jax-free but construct services,
+    # so they stay lazy for symmetry.
     if name == "SolverService":
         from .service import SolverService
         return SolverService
     if name in ("CompileCache", "bucket_key"):
         from . import compile_cache as _cc
         return getattr(_cc, name)
+    if name in ("Router", "CircuitBreaker", "TokenBucket"):
+        from . import router as _router
+        return getattr(_router, name)
+    if name in ("Replica", "ReplicaSet"):
+        from . import replica as _replica
+        return getattr(_replica, name)
     raise AttributeError(name)

@@ -1,5 +1,12 @@
 """Client API for the serve layer: submit / poll / result handles plus
-a synchronous solve() wrapper over a process-global SolverService.
+a synchronous solve() wrapper over a process-global front door.
+
+The front door is a `Router` (serve/router.py) over a replica set —
+circuit breakers, hedged retries, tenant quotas, and brownout
+degradation all live behind these same five calls.  By default the
+router runs ONE replica (`serve_replicas=1`), which behaves exactly
+like the old direct-SolverService wiring; pass `serve_replicas >= 2`
+in options to get real fault isolation.
 
 IMPORT CONTRACT: importing this module touches neither jax nor the
 service machinery — clients embed it for free (AST-guarded in
@@ -21,45 +28,50 @@ from __future__ import annotations
 
 import threading
 
-from .request import RequestHandle  # noqa: F401  (re-export, jax-free)
+from .request import (RequestHandle,  # noqa: F401  (re-export, jax-free)
+                      RouterHandle)   # noqa: F401
 
-_service = None
+_router = None
 _lock = threading.Lock()
 
 
 def start_service(options=None):
-    """Start (or return) the process-global SolverService.  `options`
-    only applies when the service is first created."""
-    global _service
+    """Start (or return) the process-global Router.  `options` only
+    applies when the router is first created; `serve_replicas`
+    defaults to 1 here (the single-replica router is behaviourally the
+    old direct service, plus admission/deadline uniformity)."""
+    global _router
     with _lock:
-        if _service is None:
-            from .service import SolverService
-            _service = SolverService(options)
-    return _service.start()
+        if _router is None:
+            from .router import Router
+            o = dict(options or {})
+            o.setdefault("serve_replicas", 1)
+            _router = Router(o)
+    return _router.start()
 
 
 def get_service():
-    """The process-global service, or None if never started."""
-    return _service
+    """The process-global router, or None if never started."""
+    return _router
 
 
 def submit(batch, options=None, **kwargs):
-    """Enqueue a solve on the global service; returns a RequestHandle."""
+    """Enqueue a solve on the global router; returns a RouterHandle."""
     return start_service().submit(batch, options, **kwargs)
 
 
 def poll(handle):
-    s = _service
-    if s is None:
+    r = _router
+    if r is None:
         return "unknown"
-    return s.poll(handle)
+    return r.poll(handle)
 
 
 def result(handle, timeout=None):
-    s = _service
-    if s is None:
+    r = _router
+    if r is None:
         return {"status": "unknown", "request_id": handle.id}
-    return s.result(handle, timeout=timeout)
+    return r.result(handle, timeout=timeout)
 
 
 def solve(batch, options=None, **kwargs):
@@ -69,10 +81,10 @@ def solve(batch, options=None, **kwargs):
 
 
 def shutdown_service(timeout=60.0):
-    """Drain and stop the global service (a later call starts a fresh
+    """Drain and stop the global router (a later call starts a fresh
     one)."""
-    global _service
+    global _router
     with _lock:
-        s, _service = _service, None
-    if s is not None:
-        s.shutdown(timeout)
+        r, _router = _router, None
+    if r is not None:
+        r.shutdown(timeout)

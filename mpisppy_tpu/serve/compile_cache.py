@@ -160,3 +160,20 @@ class CompileCache:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
                     "buckets": len(self._buckets)}
+
+
+def merged_stats(caches):
+    """Aggregate `CompileCache.stats()` across a replica set (each
+    replica owns its own cache handle, so per-replica stats only tell
+    half the story).  `buckets` sums the PER-CACHE bucket counts: the
+    same logical shape bucket compiled in two replicas IS two
+    compilations — the fault-isolation price the replica split pays,
+    and the signal this aggregate exists to expose."""
+    out = {"hits": 0, "misses": 0, "buckets": 0, "caches": 0}
+    for c in caches:
+        s = c.stats()
+        out["hits"] += s["hits"]
+        out["misses"] += s["misses"]
+        out["buckets"] += s["buckets"]
+        out["caches"] += 1
+    return out

@@ -37,6 +37,16 @@ class RequestHandle:
     id: int
 
 
+@dataclasses.dataclass(frozen=True)
+class RouterHandle:
+    """Opaque ticket returned by Router.submit() (serve/router.py).
+    Distinct from RequestHandle on purpose: one router request may map
+    to SEVERAL inner service requests over its life (hedges, replays,
+    warm_from adoptions), and only the router may translate between
+    the two id spaces."""
+    id: int
+
+
 @dataclasses.dataclass
 class SolveRequest:
     """One queued solve: the batch + options the client handed in,

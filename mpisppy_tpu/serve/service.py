@@ -66,6 +66,20 @@ from .request import (FAILED, OK, QUEUED, REJECTED, RUNNING, RequestHandle,
                       SolveRequest, failed_result, rejected_result,
                       timeout_result)
 
+# Process-wide backend execution lock.  In-process replicas (the
+# serve.replica.ReplicaSet) are separate fault domains but share ONE
+# jax backend: two dispatch threads launching collective-bearing
+# computations concurrently interleave their per-device executions and
+# deadlock XLA's cross-module all-reduce rendezvous (each participant
+# waits for device peers that are running the OTHER replica's
+# computation).  Real deployments give each replica its own process or
+# disjoint mesh slice (see mpisppy_tpu/mpmd/); in-process replica sets
+# must serialize device execution instead — queueing, draining,
+# health probing, and crash handling all stay concurrent.  Opt out
+# with serve_backend_lock=False (single-replica deployments where the
+# uncontended acquire is still ~free, or genuinely disjoint backends).
+_BACKEND_LOCK = threading.Lock()
+
 
 class SolverService:
     def __init__(self, options=None, cache=None):
@@ -99,6 +113,20 @@ class SolverService:
         self._failed = None           # terminal service failure reason
         self.restarts = 0
         self._worker = None
+        self._started = time.monotonic()
+        self.last_dispatch = None     # monotonic time of last dispatch
+        # poison attribution: _executing names the ONE request whose
+        # own per-request work (chaos tick, PH build, Iter0, single
+        # solve) the worker is inside; a crash there is precisely that
+        # request's fault and lands its id in crash_suspects (the
+        # router's quarantine signal).  Crashes in group-wide phases
+        # (batched lockstep, chaos step_tick) are ambiguous and charge
+        # nobody — blaming the whole group would quarantine innocents.
+        self._executing = None
+        self.crash_suspects = set()
+        self._backend_lock = (_BACKEND_LOCK
+                              if o.get("serve_backend_lock", True)
+                              else threading.Lock())
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
@@ -194,9 +222,38 @@ class SolverService:
         `path` (in their original submission order).  Returns a list of
         (saved_request_id, RequestHandle) pairs; saved deadlines are
         NOT carried over (absolute monotonic clocks do not survive a
-        restart)."""
+        restart).
+
+        A corrupted / truncated / wrong-format checkpoint produces a
+        STRUCTURED error dict ({"status": "failed", "reason":
+        "corrupt_drain_checkpoint", ...}) instead of an exception, and
+        the whole file is validated BEFORE the first resubmit — a bad
+        entry can never leave the service half-warmed.  The service
+        keeps accepting either way."""
         from ..resilience.checkpoint import load_drain_checkpoint
-        saved = load_drain_checkpoint(path)
+        try:
+            saved = load_drain_checkpoint(path)
+        except Exception as exc:
+            self._tel.event("serve.warm_from_rejected", path=str(path),
+                            error=repr(exc))
+            global_toc(f"WARNING: serve warm_from rejected {path}: "
+                       f"{exc!r}")
+            return {"status": FAILED,
+                    "reason": "corrupt_drain_checkpoint",
+                    "path": str(path), "error": repr(exc)}
+        # validate every entry up front: raising mid-resubmit would
+        # warm an arbitrary prefix and lose the rest
+        required = ("id", "batch", "options", "scenario_names", "model")
+        for pos, d in enumerate(saved):
+            missing = [k for k in required
+                       if not isinstance(d, dict) or k not in d]
+            if missing:
+                self._tel.event("serve.warm_from_rejected",
+                                path=str(path), entry=pos)
+                return {"status": FAILED,
+                        "reason": "corrupt_drain_checkpoint",
+                        "path": str(path),
+                        "error": f"entry {pos} missing keys {missing}"}
         self.start()
         handles = []
         for d in saved:
@@ -252,6 +309,27 @@ class SolverService:
         with self._lock:
             req = self._requests.get(handle.id)
             return "unknown" if req is None else req.status
+
+    def health(self):
+        """One structured health snapshot — the router's probe input.
+        `last_dispatch_age` is seconds since the worker last dispatched
+        a group (since start() when it never has); a large age with a
+        nonempty queue is the hang/slow signal, mirroring the wheel
+        supervisor's write-id staleness heartbeat."""
+        now = time.monotonic()
+        with self._lock:
+            ref = self.last_dispatch if self.last_dispatch is not None \
+                else self._started
+            return {
+                "failed": self._failed,
+                "draining": self._draining,
+                "stopped": self._stopped,
+                "queue_depth": len(self._queue),
+                "inflight": len(self._inflight),
+                "last_dispatch_age": now - ref,
+                "restarts": self.restarts,
+                "crash_suspects": set(self.crash_suspects),
+            }
 
     def result(self, handle, timeout=None):
         """Block for the result — ALWAYS time-bounded: by `timeout`,
@@ -355,9 +433,12 @@ class SolverService:
 
     def _process_group(self, group):
         self._dispatches += 1
+        self.last_dispatch = time.monotonic()
         # chaos: each dispatched group is one "step" (crash/hang from
-        # step N on); crash_at_iter counts dispatches and fires EXACTLY
-        # once — the restart-and-recover test shape
+        # step N on, replica_crash from dispatch N on); crash_at_iter
+        # counts dispatches and fires EXACTLY once — the
+        # restart-and-recover test shape; slow_replica sleeps here
+        self._chaos.pre_dispatch()
         self._chaos.step_tick()
         self._chaos.hub_iter_tick(self._dispatches)
         self._tel.histogram("serve.batch_size").observe(len(group))
@@ -378,8 +459,17 @@ class SolverService:
         global_toc(f"WARNING: serve dispatch worker crashed: {exc!r}")
         self._tel.event("serve.worker_crash", error=repr(exc))
         with self._lock:
+            suspect = self._executing
+            self._executing = None
+            if suspect is not None:
+                self.crash_suspects.add(suspect)
             for req in list(self._inflight):
-                req.attempts += 1
+                # the ATTEMPT budget is charged only to the request the
+                # worker was executing (the precise suspect) — innocents
+                # coalesced into the group requeue freely; the restart
+                # budget still bounds total crashes either way
+                if req.id == suspect:
+                    req.attempts += 1
                 if req.attempts >= self.max_attempts:
                     self._finish_locked(req, failed_result(
                         req.id, f"worker crashed ({exc!r}) and the "
@@ -427,11 +517,23 @@ class SolverService:
         return PH(dict(req.options), list(names), batch=req.batch)
 
     def _execute_group(self, group):
+        # serialize device execution across in-process services that
+        # share one jax backend (see _BACKEND_LOCK above); a crash
+        # (ChaosError, poison) unwinding through here releases it
+        with self._backend_lock:
+            self._execute_group_locked(group)
+
+    def _execute_group_locked(self, group):
         live = []
         for req in group:
             if req.expired():
                 self._finish(req, timeout_result(req, where="dispatch"))
                 continue
+            self._executing = req.id   # precise poison attribution
+            # poison_request chaos: raising HERE (not inside the try)
+            # makes the poison a worker crash — exactly what a
+            # deterministically-lethal request does to a real replica
+            self._chaos.request_tick(req.options)
             try:
                 with self._tel.span("serve.request", request=req.id):
                     ph = self._build_ph(req)
@@ -441,12 +543,21 @@ class SolverService:
             except Exception as exc:  # e.g. certified-infeasible iter0
                 self._finish(req, failed_result(req.id, repr(exc)))
                 continue
+            finally:
+                self._executing = None
             live.append((req, ph))
         if not live:
             return
         if len(live) == 1:
-            self._run_single(*live[0])
+            req, ph = live[0]
+            self._executing = req.id
+            try:
+                self._run_single(req, ph)
+            finally:
+                self._executing = None
         else:
+            # batched lockstep: a crash here is ambiguous (every
+            # request is executing) — charge nobody
             self._run_batched(live, engine)
 
     def _run_single(self, req, ph):

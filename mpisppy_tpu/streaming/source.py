@@ -185,10 +185,17 @@ class RetryingSource(ScenarioSource):
     after `retries` failed re-attempts the structured SourceBuildError
     surfaces.  StreamingPH wires this automatically when the options
     carry `source_retries` (with `source_backoff`/`source_backoff_cap`
-    shaping the delay like the supervisor's restart ladder)."""
+    shaping the delay like the supervisor's restart ladder).
+
+    Delays carry multiplicative JITTER (default +/- `jitter`=0.25 of
+    the ladder value, capped at backoff_cap): a fixed ladder makes
+    every concurrent block retry at the same instants, turning one
+    transient store hiccup into a synchronized retry storm.  Every
+    retry increments the `stream.source_retries` telemetry counter."""
 
     def __init__(self, source, retries=2, backoff=0.05, backoff_cap=5.0,
-                 chaos=None):
+                 chaos=None, jitter=0.25, jitter_seed=None):
+        import random
         self.inner = source
         self.name = source.name
         self.total_scens = int(source.total_scens)
@@ -196,12 +203,24 @@ class RetryingSource(ScenarioSource):
         self.backoff = float(backoff)
         self.backoff_cap = float(backoff_cap)
         self.chaos = chaos             # block_build_fail injection point
+        self.jitter = float(jitter)
+        self._rng = random.Random(jitter_seed)
         self.retry_log = []
+
+    def _delay(self, attempt):
+        """The supervisor ladder value, spread by +/- jitter and
+        re-capped (jitter never pushes a delay past backoff_cap)."""
+        from ..resilience.supervisor import restart_delay
+        base = restart_delay(attempt, self.backoff, self.backoff_cap)
+        if self.jitter <= 0:
+            return base
+        spread = base * self._rng.uniform(-self.jitter, self.jitter)
+        return min(self.backoff_cap, max(0.0, base + spread))
 
     def block(self, indices):
         import time
 
-        from ..resilience.supervisor import restart_delay
+        from .. import telemetry as _telemetry
 
         last = None
         for attempt in range(1, self.retries + 2):
@@ -213,11 +232,11 @@ class RetryingSource(ScenarioSource):
                 last = e
                 if attempt > self.retries:
                     break
-                delay = restart_delay(attempt, self.backoff,
-                                      self.backoff_cap)
+                delay = self._delay(attempt)
                 self.retry_log.append(
                     {"attempt": attempt, "error": str(e),
                      "delay": delay})
+                _telemetry.get().counter("stream.source_retries").inc()
                 time.sleep(delay)
         raise SourceBuildError(
             f"scenario block build failed after {self.retries} "

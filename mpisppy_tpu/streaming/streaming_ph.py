@@ -102,7 +102,9 @@ class StreamingPH(PHBase):
                 source, retries=retries,
                 backoff=float(o.get("source_backoff", 0.05)),
                 backoff_cap=float(o.get("source_backoff_cap", 5.0)),
-                chaos=ChaosInjector.from_options(o.get("chaos")))
+                chaos=ChaosInjector.from_options(o.get("chaos")),
+                jitter=float(o.get("source_jitter", 0.25)),
+                jitter_seed=o.get("source_jitter_seed"))
         self.source = source
         self.module = module
         self.total_scens = int(source.total_scens)

@@ -231,7 +231,8 @@ def stream_counters(registry=None):
     overlap block i's solve)."""
     reg = registry if registry is not None else get().registry
     names = ("stream.blocks_loaded", "stream.scenarios_streamed",
-             "stream.sample_growth_events", "stream.supersteps")
+             "stream.sample_growth_events", "stream.supersteps",
+             "stream.source_retries")
     vals = ({k: c.value for k, c in reg._counters.items()}
             if reg.enabled else {})
     out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
@@ -286,3 +287,24 @@ def serve_counters(registry=None):
     vals = ({k: c.value for k, c in reg._counters.items()}
             if reg.enabled else {})
     return {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
+
+
+def router_counters(registry=None):
+    """Router-layer (replica-set front door) counter dict for bench
+    JSON — stable keys whether or not telemetry was on."""
+    reg = registry if registry is not None else get().registry
+    names = ("router.requests_submitted", "router.requests_ok",
+             "router.requests_timeout", "router.requests_failed",
+             "router.requests_rejected", "router.hedged_requests",
+             "router.shed_hedges", "router.shed_requests",
+             "router.over_quota", "router.breaker_opens",
+             "router.replica_restarts", "router.replayed_requests",
+             "router.quarantined", "router.duplicate_completions",
+             "router.degraded_requests")
+    vals = ({k: c.value for k, c in reg._counters.items()}
+            if reg.enabled else {})
+    out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
+    g = (reg._gauges.get("router.brownout_level")
+         if reg.enabled else None)
+    out["router_brownout_level"] = int(g.value) if g is not None else 0
+    return out

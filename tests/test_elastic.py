@@ -406,13 +406,14 @@ class TestShutdownShares:
         assert all("share" in str(e) for e in reports)
 
 
-# ---- layering guard: resilience/ never imports mpmd ----------------------
+# ---- layering guard: resilience/ never imports mpmd or serve -------------
 
 class TestResilienceLayering:
-    def test_resilience_never_imports_mpmd(self):
-        """Mirror of the cylinders<->mpmd rule: resilience/ serves the
-        wheel through generic hub/spoke/window interfaces only — ANY
-        import of mpmd (even lazy) would invert the dependency."""
+    def _assert_never_imports(self, forbidden):
+        """resilience/ is the BOTTOM of the robustness stack: both the
+        wheel (mpmd) and the replica-set front door (serve) build on
+        it, so ANY import the other way (even lazy, anywhere in a
+        function body) inverts the dependency."""
         res_dir = os.path.join(PKG_ROOT, "resilience")
         for fn in sorted(os.listdir(res_dir)):
             if not fn.endswith(".py"):
@@ -421,12 +422,20 @@ class TestResilienceLayering:
             for node in ast.walk(tree):
                 if isinstance(node, ast.Import):
                     for a in node.names:
-                        assert "mpmd" not in a.name.split("."), \
-                            f"resilience/{fn} imports mpmd"
+                        assert forbidden not in a.name.split("."), \
+                            f"resilience/{fn} imports {forbidden}"
                 elif isinstance(node, ast.ImportFrom):
                     mod = node.module or ""
-                    assert "mpmd" not in mod.split("."), \
-                        f"resilience/{fn} imports from mpmd"
+                    assert forbidden not in mod.split("."), \
+                        f"resilience/{fn} imports from {forbidden}"
                     for a in node.names:
-                        assert a.name != "mpmd", \
-                            f"resilience/{fn} imports mpmd"
+                        assert a.name != forbidden, \
+                            f"resilience/{fn} imports {forbidden}"
+
+    def test_resilience_never_imports_mpmd(self):
+        self._assert_never_imports("mpmd")
+
+    def test_resilience_never_imports_serve(self):
+        """PR 11: serve/ consumes resilience (chaos, restart_delay,
+        drain checkpoints); resilience/ must never know serve exists."""
+        self._assert_never_imports("serve")

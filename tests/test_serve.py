@@ -59,9 +59,13 @@ def _module_level_imports(path):
 
 def test_api_imports_jax_only_lazily():
     """serve/api.py (and the package front door) must be free to
-    import: no module-level jax, directly or transitively."""
+    import: no module-level jax, directly or transitively.  The PR 11
+    traffic layer (router.py, replica.py) is held to the same bar —
+    the front door must be constructible in a process that never
+    initializes a backend until a replica dispatches."""
     serve_dir = REPO / "mpisppy_tpu" / "serve"
-    for fname in ("api.py", "__init__.py", "request.py"):
+    for fname in ("api.py", "__init__.py", "request.py",
+                  "router.py", "replica.py"):
         mods = _module_level_imports(serve_dir / fname)
         bad = {m for m in mods
                if m == "jax" or m.startswith("jax.")}
@@ -76,6 +80,8 @@ def test_api_import_is_jax_free_in_fresh_process():
     code = ("import sys\n"
             "import mpisppy_tpu.serve.api\n"
             "import mpisppy_tpu.serve\n"
+            "import mpisppy_tpu.serve.router\n"
+            "import mpisppy_tpu.serve.replica\n"
             "sys.exit(1 if 'jax' in sys.modules else 0)\n")
     r = subprocess.run([sys.executable, "-c", code],
                        capture_output=True, text=True, timeout=120)

@@ -1,0 +1,620 @@
+"""Replica-set front-door tests (PR 11): circuit breakers, hedged
+retries + idempotency, tenant quotas, brownout ladder, poison
+quarantine, replace-and-replay — plus the chaos-on open-load
+acceptance test and the warm_from corruption regression.
+
+Two tiers inside this file:
+  * pure-router unit tests drive `Router` against FAKE replicas (no
+    jax, milliseconds) — the traffic logic is jax-free by contract, so
+    it is testable without a backend;
+  * the acceptance tests run REAL replicas (SolverService) under
+    injected chaos with farmer-sized batches.
+"""
+
+import itertools
+import pathlib
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from mpisppy_tpu import telemetry
+from mpisppy_tpu.serve.router import (CircuitBreaker, Router, TokenBucket)
+
+pytestmark = pytest.mark.serve
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+FAST_OPTS = {"defaultPHrho": 1.0, "PHIterLimit": 4, "convthresh": 1e-4,
+             "pdhg_eps": 1e-7, "superstep_eps": 1e-5}
+
+
+def _wait_for(cond, timeout=5.0, interval=0.005):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _is_subsequence(needle, haystack):
+    it = iter(haystack)
+    return all(x in it for x in needle)
+
+
+# -- breaker + bucket state machines (no replicas at all) ------------------
+
+class TestCircuitBreaker:
+    def test_traversal_closed_open_half_open_closed(self):
+        br = CircuitBreaker(fail_threshold=2, backoff=1.0, backoff_cap=8.0)
+        t = 100.0
+        assert br.allow(t)
+        br.record_failure(t)
+        assert br.state == "closed"          # below threshold
+        br.record_failure(t)
+        assert br.state == "open"            # tripped
+        assert not br.allow(t + 0.5)         # reopen timer not expired
+        assert br.allow(t + 1.1)             # probe flips to half-open
+        assert br.state == "half_open"
+        br.record_success(t + 1.2)
+        assert br.state == "closed"
+        assert _is_subsequence(
+            ["closed", "open", "half_open", "closed"], br.states_seen())
+
+    def test_half_open_failure_reopens_with_longer_backoff(self):
+        br = CircuitBreaker(fail_threshold=1, backoff=1.0, backoff_cap=8.0)
+        t = 10.0
+        br.record_failure(t)                 # trip 1: reopen_at = t + 1
+        assert br.reopen_at == pytest.approx(t + 1.0)
+        assert br.allow(t + 1.5)             # half-open probe
+        br.record_failure(t + 1.5)           # probe fails: trip 2
+        assert br.state == "open"
+        assert br.reopen_at == pytest.approx(t + 1.5 + 2.0)  # 2^1 * backoff
+        assert br.opens == 2
+
+    def test_reopen_backoff_is_capped(self):
+        br = CircuitBreaker(fail_threshold=1, backoff=1.0, backoff_cap=3.0)
+        t = 0.0
+        for _ in range(6):                   # trip over and over
+            br.trip(t)
+            assert br.reopen_at - t <= 3.0 + 1e-9
+            t = br.reopen_at
+            assert br.allow(t)               # half-open
+        assert br.opens == 6
+
+    def test_success_resets_failure_count(self):
+        br = CircuitBreaker(fail_threshold=3)
+        t = 0.0
+        br.record_failure(t)
+        br.record_failure(t)
+        br.record_success(t)
+        br.record_failure(t)
+        br.record_failure(t)
+        assert br.state == "closed"          # never 3 consecutive
+
+
+class TestTokenBucket:
+    def test_burst_then_starve_then_refill(self):
+        tb = TokenBucket(rate=10.0, burst=2)
+        t = tb.stamp
+        assert tb.take(t) and tb.take(t)
+        assert not tb.take(t)                # burst spent
+        assert tb.take(t + 0.12)             # one token refilled
+        assert not tb.take(t + 0.12)
+
+    def test_refill_never_exceeds_burst(self):
+        tb = TokenBucket(rate=100.0, burst=3)
+        t = tb.stamp
+        tb.take(t)
+        # a long idle period refills to AT MOST burst, not rate*idle
+        for _ in range(3):
+            assert tb.take(t + 100.0)
+        assert not tb.take(t + 100.0)
+
+
+# -- fake replicas: deterministic router-logic tests -----------------------
+
+class FakeReplica:
+    """Duck-typed Replica: completes every request with a canned OK
+    (or canned terminal) result after `latency` seconds; `black_hole`
+    never completes.  Health is whatever the test sets."""
+
+    def __init__(self, slot, incarnation=0, latency=0.0, behavior="ok"):
+        self.slot = slot
+        self.incarnation = incarnation
+        self.name = f"f{slot}i{incarnation}"
+        self.condemned = False
+        self.failed = False
+        self.assigned = {}
+        self.latency = latency
+        self.behavior = behavior
+        self.submitted = []
+        self._ids = itertools.count(1)
+        self._pending = {}               # id -> (ready_at, result)
+        self.health_overrides = {}
+
+    def start(self):
+        return self
+
+    def submit(self, batch, options=None, scenario_names=None,
+               deadline=None, model=None):
+        i = next(self._ids)
+        self.submitted.append((i, options))
+        if self.behavior == "black_hole":
+            res = None
+        elif self.behavior == "fail":
+            res = {"status": "failed", "reason": "canned failure"}
+        else:
+            res = {"status": "ok", "eobj": -1.0, "conv": 0.0,
+                   "solved_by": self.name}
+        self._pending[i] = (time.monotonic() + self.latency, res)
+        return types.SimpleNamespace(id=i)
+
+    def peek(self, handle):
+        ready_at, res = self._pending[handle.id]
+        if res is None or time.monotonic() < ready_at:
+            return None
+        return dict(res, request_id=handle.id)
+
+    def poll(self, handle):
+        r = self.peek(handle)
+        return "queued" if r is None else r["status"]
+
+    def health(self):
+        h = {"failed": None, "draining": False, "stopped": False,
+             "queue_depth": 0, "inflight": 0, "last_dispatch_age": 0.0,
+             "restarts": 0, "crash_suspects": set()}
+        h.update(self.health_overrides)
+        return h
+
+    def drain(self, deadline=1.0, checkpoint_path=None):
+        return {"drained": 0, "checkpoint": None}
+
+    def warm_from(self, path):
+        return []
+
+    def shutdown(self, timeout=5.0):
+        pass
+
+
+class FakeSet:
+    def __init__(self, replicas):
+        self.replicas = list(replicas)
+        self.replacements = 0
+
+    def start(self):
+        return self
+
+    def shutdown(self, timeout=5.0):
+        pass
+
+    def __iter__(self):
+        return iter(self.replicas)
+
+    def __len__(self):
+        return len(self.replicas)
+
+    def __getitem__(self, slot):
+        return self.replicas[slot]
+
+    def replace(self, slot, drain_deadline=1.0, checkpoint_path=None):
+        corpse = self.replicas[slot]
+        corpse.condemned = True
+        self.replacements += 1
+        fresh = FakeReplica(slot, incarnation=corpse.incarnation + 1)
+        self.replicas[slot] = fresh
+        return fresh, {"drained": 0, "checkpoint": None}, []
+
+
+def _fake_router(replicas, **opts):
+    o = {"router_tick": 0.002, "router_probe_interval": 0.004,
+         "router_hedge_threshold": None, "router_brownout_interval": 0.01}
+    o.update(opts)
+    return Router(o, replica_set=FakeSet(replicas)).start()
+
+
+class TestRouterLogic:
+    def test_solve_roundtrip_and_least_loaded_pick(self):
+        r0, r1 = FakeReplica(0), FakeReplica(1)
+        r1.health_overrides["queue_depth"] = 5   # r0 is less loaded
+        router = _fake_router([r0, r1])
+        try:
+            res = router.solve("B", {"x": 1}, timeout=5)
+            assert res["status"] == "ok"
+            assert res["replica"] == "f0i0"
+            assert "router_wall_s" in res
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_idempotency_key_dedupes_to_one_request(self):
+        router = _fake_router([FakeReplica(0)])
+        try:
+            h1 = router.submit("B", idempotency_key="job-1")
+            h2 = router.submit("B", idempotency_key="job-1")
+            assert h1.id == h2.id
+            assert router.counts["requests_submitted"] == 1
+            res1 = router.result(h1, timeout=5)
+            # a LATE duplicate submit resolves instantly to the same
+            # already-computed result — the dedup half of exactly-once
+            h3 = router.submit("B", idempotency_key="job-1")
+            assert router.result(h3, timeout=1) is res1
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_hedge_fires_and_first_completion_wins(self):
+        slow, fast = FakeReplica(0, latency=0.4), FakeReplica(1)
+        fast.health_overrides["queue_depth"] = 1  # initial pick: slot 0
+        router = _fake_router([slow, fast], router_hedge_threshold=0.05)
+        try:
+            h = router.submit("B")
+            res = router.result(h, timeout=5)
+            assert res["status"] == "ok"
+            assert res["replica"] == "f1i0"       # hedge won
+            assert router.counts["hedged_requests"] == 1
+            # the slow twin completes later: observed, counted, never
+            # delivered — and the request leaves the lingering table
+            assert _wait_for(
+                lambda: router.counts.get("duplicate_completions", 0) == 1)
+            assert _wait_for(lambda: not router._lingering)
+            assert router.result(h, timeout=1)["replica"] == "f1i0"
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_tenant_token_bucket_rejects_over_quota(self):
+        router = _fake_router([FakeReplica(0)],
+                              router_tenant_rate=0.001,
+                              router_tenant_burst=2)
+        try:
+            r1 = router.result(router.submit("B", tenant="acme"), timeout=5)
+            r2 = router.result(router.submit("B", tenant="acme"), timeout=5)
+            r3 = router.result(router.submit("B", tenant="acme"), timeout=5)
+            assert r1["status"] == r2["status"] == "ok"
+            assert r3["status"] == "rejected"
+            assert r3["reason"] == "over_quota"
+            # independent tenants have independent buckets
+            other = router.result(router.submit("B", tenant="zeta"),
+                                  timeout=5)
+            assert other["status"] == "ok"
+            assert router.counts["over_quota"] == 1
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_breaker_gates_routing_and_recovers(self):
+        r0, r1 = FakeReplica(0), FakeReplica(1)
+        router = _fake_router([r0, r1],
+                              router_breaker_failures=2,
+                              router_breaker_backoff=0.05,
+                              router_breaker_backoff_cap=0.2,
+                              router_breaker_queue_depth=4)
+        try:
+            # unhealthy probes (deep queue) open slot 0's breaker
+            r0.health_overrides["queue_depth"] = 100
+            assert _wait_for(
+                lambda: router.breakers[0].state == "open")
+            res = router.solve("B", timeout=5)
+            assert res["replica"] == "f1i0"      # slot 0 shed
+            # recovery: healthy probes close it through half-open
+            r0.health_overrides["queue_depth"] = 0
+            assert _wait_for(
+                lambda: router.breakers[0].state == "closed", timeout=3)
+            assert _is_subsequence(
+                ["closed", "open", "half_open", "closed"],
+                router.breakers[0].states_seen())
+            assert router.counts["breaker_opens"] >= 1
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_failed_replica_replaced_and_request_replayed(self):
+        r0, r1 = FakeReplica(0, behavior="black_hole"), FakeReplica(1)
+        r1.health_overrides["queue_depth"] = 9   # first pick: slot 0
+        router = _fake_router([r0, r1])
+        try:
+            h = router.submit("B")
+            time.sleep(0.02)
+            r0.health_overrides["failed"] = "boom"
+            r0.failed = True
+            res = router.result(h, timeout=5)
+            assert res["status"] == "ok"          # replayed, not lost
+            assert router.counts["replica_restarts"] == 1
+            assert router.counts.get("replayed_requests", 0) >= 1
+            assert router.replica_set.replacements == 1
+            assert router.replica_set[0].incarnation == 1
+            assert router.breakers[0].opens >= 1
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_poison_budget_quarantines_attributed_request(self):
+        r0 = FakeReplica(0, behavior="black_hole")
+        router = _fake_router([r0], router_poison_budget=1)
+        try:
+            h = router.submit("B")
+            assert _wait_for(lambda: r0.assigned)
+            inner_id = next(iter(r0.assigned))
+            # the service attributes the crash to THIS request
+            r0.health_overrides["crash_suspects"] = {inner_id}
+            res = router.result(h, timeout=5)
+            assert res["status"] == "failed"
+            assert "quarantined" in res["reason"]
+            assert router.counts["quarantined"] == 1
+            # no replacement happened: quarantine is request-scoped
+            assert router.replica_set.replacements == 0
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_failed_results_respect_attempt_budget(self):
+        router = _fake_router([FakeReplica(0, behavior="fail"),
+                               FakeReplica(1, behavior="fail")],
+                              router_max_attempts=2)
+        try:
+            res = router.solve("B", timeout=5)
+            assert res["status"] == "failed"
+            assert router.counts["requests_failed"] == 1
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_router_deadline_sweeps_unresolvable_request(self):
+        router = _fake_router([FakeReplica(0, behavior="black_hole")])
+        try:
+            res = router.solve("B", deadline=0.1, timeout=5)
+            assert res["status"] == "timeout"
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_shutdown_rejects_new_and_unresolved(self):
+        router = _fake_router([FakeReplica(0, behavior="black_hole")])
+        h = router.submit("B")
+        router.shutdown(timeout=1)
+        assert router.result(h, timeout=1)["status"] == "rejected"
+        late = router.submit("B")
+        assert router.result(late, timeout=1)["reason"] == "shutdown"
+
+
+class TestBrownoutLadder:
+    def test_overload_escalates_and_relaxes(self):
+        """Sustained load above the high-water fraction walks the
+        ladder up one level per sustained eval; load draining away
+        walks it back down.  Every transition is recorded."""
+        slow = FakeReplica(0, latency=0.25)
+        router = _fake_router(
+            [slow], serve_max_inflight=1,
+            router_brownout_high=0.5, router_brownout_low=0.25,
+            router_brownout_sustain=1, router_brownout_interval=0.01)
+        try:
+            handles = [router.submit("B") for _ in range(4)]
+            assert _wait_for(lambda: router.brownout_level >= 1,
+                             timeout=3)
+            for h in handles:
+                router.result(h, timeout=5)
+            assert _wait_for(lambda: router.brownout_level == 0,
+                             timeout=3)
+            levels = [lv for lv, _ in router.brownout_transitions]
+            assert levels[0] == 1            # stepwise, not a jump
+            assert levels[-1] == 0
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_level1_sheds_hedges(self):
+        slow = FakeReplica(0, latency=0.3)
+        router = _fake_router([slow, FakeReplica(1)],
+                              router_hedge_threshold=0.02,
+                              router_brownout_interval=1e9)
+        try:
+            router.brownout_level = 1
+            slow.health_overrides["queue_depth"] = 0
+            h = router.submit("B")
+            res = router.result(h, timeout=5)
+            assert res["status"] == "ok"
+            assert router.counts.get("hedged_requests", 0) == 0
+            assert router.counts["shed_hedges"] == 1
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_level2_widens_eps_of_admitted_requests(self):
+        router = _fake_router([FakeReplica(0)],
+                              router_brownout_conv_factor=10.0,
+                              router_brownout_interval=1e9)
+        try:
+            router.brownout_level = 2
+            h = router.submit("B", options={"convthresh": 1e-4})
+            rreq = router._requests[h.id]
+            assert rreq.options["convthresh"] == pytest.approx(1e-3)
+            assert rreq.options["eps_ladder"]["start"] >= \
+                rreq.options["eps_ladder"]["min"]
+            assert router.counts["degraded_requests"] == 1
+            assert router.result(h, timeout=5)["status"] == "ok"
+        finally:
+            router.shutdown(timeout=1)
+
+    def test_level3_rejects_low_priority_tenants(self):
+        router = _fake_router([FakeReplica(0)],
+                              router_brownout_min_priority=1,
+                              router_brownout_interval=1e9)
+        try:
+            router.brownout_level = 3
+            res_lo = router.result(
+                router.submit("B", priority=0), timeout=5)
+            assert res_lo["status"] == "rejected"
+            assert res_lo["reason"] == "brownout_shed"
+            res_hi = router.result(
+                router.submit("B", priority=1), timeout=5)
+            assert res_hi["status"] == "ok"
+            assert router.counts["shed_requests"] == 1
+        finally:
+            router.shutdown(timeout=1)
+
+
+# -- telemetry accessor ----------------------------------------------------
+
+def test_router_counters_keys_stable_on_and_off():
+    off = telemetry.router_counters(
+        telemetry.Telemetry({"enabled": False}).registry)
+    assert all(v == 0 for v in off.values())
+    tel = telemetry.Telemetry({"enabled": True})
+    tel.counter("router.hedged_requests").inc(3)
+    tel.gauge("router.brownout_level").set(2)
+    on = telemetry.router_counters(tel.registry)
+    assert set(on) == set(off)
+    assert on["router_hedged_requests"] == 3
+    assert on["router_brownout_level"] == 2
+
+
+# -- warm_from corruption regression (satellite 2) -------------------------
+
+class TestWarmFromCorruption:
+    def _drained_checkpoint(self, tmp_path):
+        from mpisppy_tpu.models import farmer
+        from mpisppy_tpu.serve.service import SolverService
+
+        svc = SolverService()            # never started: request stays
+        svc.submit(farmer.build_batch(3), FAST_OPTS, model="farmer")
+        info = svc.drain(deadline=0.05,
+                         checkpoint_path=str(tmp_path / "drain"))
+        assert info["drained"] == 1 and info["checkpoint"]
+        return pathlib.Path(info["checkpoint"])
+
+    def _assert_rejected_and_alive(self, out, svc):
+        from mpisppy_tpu.models import farmer
+
+        assert isinstance(out, dict), out
+        assert out["status"] == "failed"
+        assert out["reason"] == "corrupt_drain_checkpoint"
+        assert "error" in out and "path" in out
+        # the service is NOT poisoned: it still accepts and solves
+        h = svc.submit(farmer.build_batch(3), FAST_OPTS, model="farmer")
+        svc.start()
+        try:
+            assert svc.result(h, timeout=600)["status"] == "ok"
+        finally:
+            svc.shutdown(timeout=5)
+
+    def test_bitflipped_checkpoint_is_structured_reject(self, tmp_path):
+        from mpisppy_tpu.serve.service import SolverService
+
+        p = self._drained_checkpoint(tmp_path)
+        raw = bytearray(p.read_bytes())
+        mid = len(raw) // 2              # inside member data: the zip
+        for i in range(8):               # CRC catches the flip
+            raw[mid + i] ^= 0xFF
+        p.write_bytes(bytes(raw))
+        svc = SolverService()
+        self._assert_rejected_and_alive(svc.warm_from(str(p)), svc)
+
+    def test_truncated_checkpoint_is_structured_reject(self, tmp_path):
+        from mpisppy_tpu.serve.service import SolverService
+
+        p = self._drained_checkpoint(tmp_path)
+        p.write_bytes(p.read_bytes()[: len(p.read_bytes()) // 3])
+        svc = SolverService()
+        self._assert_rejected_and_alive(svc.warm_from(str(p)), svc)
+
+    def test_entry_missing_keys_is_structured_reject(self, tmp_path):
+        """A well-formed npz whose entries lack required request keys
+        is rejected BEFORE any resubmit — never a half-warmed service."""
+        from mpisppy_tpu.resilience.checkpoint import save_drain_checkpoint
+        from mpisppy_tpu.serve.service import SolverService
+
+        path = save_drain_checkpoint(
+            str(tmp_path / "bad"), [{"id": 1, "options": {}}])
+        svc = SolverService()
+        out = svc.warm_from(path)
+        assert out["status"] == "failed"
+        assert out["reason"] == "corrupt_drain_checkpoint"
+        assert "missing keys" in out["error"]
+        assert not svc._requests     # nothing was resubmitted
+
+
+# -- chaos-on open-load acceptance (the ISSUE 11 e2e) ----------------------
+
+@pytest.mark.chaos
+def test_open_load_with_chaos_exactly_once_and_bounded_p99():
+    """Open-load generator against a 2-replica set with replica_crash +
+    slow_replica + poison_request armed:
+
+      * every admitted request resolves EXACTLY once — no lost results,
+        duplicate completions suppressed through the idempotency table;
+      * batch=1 results are bitwise-identical to PH.ph_main;
+      * the poison request is quarantined without pruning more than
+        one replica;
+      * slot 0's breaker traverses closed -> open -> half_open ->
+        closed across the replacement;
+      * p99 is finite and bounded, with breaker_opens >= 1 and
+        replica_restarts >= 1 (the bench chaos row's signals)."""
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.opt.ph import PH
+
+    names = [f"scen{i}" for i in range(3)]
+    ph = PH(dict(FAST_OPTS), names, batch=farmer.build_batch(3))
+    g_conv, g_eobj, g_trivial = ph.ph_main()
+
+    router = Router({
+        "serve_replicas": 2,
+        "serve_max_batch": 1,            # singleton groups: bitwise path
+        "serve_restart_backoff": 0.01,
+        "serve_restart_backoff_cap": 0.05,
+        "router_tick": 0.01, "router_probe_interval": 0.02,
+        "router_hedge_threshold": 1.0,
+        "router_breaker_backoff": 0.05,
+        "router_breaker_backoff_cap": 0.5,
+        "router_drain_deadline": 0.3,
+        "chaos": {"replica_crash": 1, "slow_replica": 0.02,
+                  "poison_request": True, "chaos_replica": 0},
+    }).start()
+    handles = {}
+    try:
+        batch = farmer.build_batch(3)
+        # open loop: submit at a fixed rate, never waiting on results
+        for i in range(8):
+            handles[f"req{i}"] = router.submit(
+                batch, FAST_OPTS, scenario_names=names, model="farmer",
+                idempotency_key=f"req{i}")
+            if i == 3:                   # poison mid-stream
+                handles["poison"] = router.submit(
+                    batch, dict(FAST_OPTS, chaos_poison=True),
+                    scenario_names=names, model="farmer",
+                    idempotency_key="poison")
+            time.sleep(0.05)
+        results = {k: router.result(h, timeout=300)
+                   for k, h in handles.items()}
+
+        # exactly-once: every request terminal, one rid per key, and a
+        # re-ask returns the SAME result object (no second delivery)
+        assert len(router._idempotency) == len(handles)
+        for k, h in handles.items():
+            assert results[k]["status"] in ("ok", "failed"), results[k]
+            assert router.result(h, timeout=1) is results[k]
+            assert router.submit(batch, FAST_OPTS,
+                                 idempotency_key=k).id == h.id
+
+        # poison: quarantined; everything else solved
+        assert results["poison"]["status"] == "failed"
+        assert "quarantined" in results["poison"]["reason"]
+        oks = {k: r for k, r in results.items() if k != "poison"}
+        assert all(r["status"] == "ok" for r in oks.values()), \
+            {k: r["status"] for k, r in oks.items()}
+
+        # bitwise parity at batch=1 (every group is a singleton)
+        for r in oks.values():
+            assert r["conv"] == g_conv
+            assert r["eobj"] == g_eobj
+            assert r["trivial_bound"] == g_trivial
+            assert np.array_equal(r["xbar"], np.asarray(ph.root_xbar()))
+
+        st = router.stats()
+        # only the chaos-targeted replica was pruned
+        assert st["replica_restarts"] == 1
+        assert router.replica_set[0].incarnation == 1
+        assert router.replica_set[1].incarnation == 0
+        # breaker traversal on the crashed slot
+        assert st["counts"]["breaker_opens"] >= 1
+        assert _is_subsequence(
+            ["closed", "open", "half_open", "closed"],
+            st["breakers"][0]["states_seen"])
+        # bounded latency under chaos
+        assert st["p99"] is not None and np.isfinite(st["p99"])
+        assert st["p99"] < 240.0
+        assert st["counts"]["quarantined"] == 1
+    finally:
+        router.shutdown(timeout=10)

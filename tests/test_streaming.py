@@ -317,7 +317,7 @@ def test_streaming_ph_rejects_w_bounds():
 def test_stream_counters_keys_stable_on_and_off():
     keys = {"stream_blocks_loaded", "stream_scenarios_streamed",
             "stream_sample_growth_events", "stream_supersteps",
-            "stream_active_sample_size",
+            "stream_source_retries", "stream_active_sample_size",
             "stream_prefetch_wait_seconds"}
     off = telemetry.stream_counters(
         telemetry.Telemetry({"enabled": False}).registry)
@@ -406,6 +406,56 @@ def test_retrying_source_wraps_non_chaos_errors_too():
     with pytest.raises(SourceBuildError, match="failed after 0 retries"):
         src.block(np.array([99]))        # IndexError inside, wrapped
     assert src.retry_log == []
+
+
+def test_retrying_source_backoff_is_jittered_and_capped():
+    """PR 11: fixed retry delays synchronize retry storms across
+    concurrent blocks — the delay must carry jitter, the jitter must
+    never push a delay past backoff_cap, and every retry bumps the
+    stream.source_retries telemetry counter."""
+    from mpisppy_tpu.resilience.chaos import ChaosInjector
+    from mpisppy_tpu.resilience.supervisor import restart_delay
+    from mpisppy_tpu.streaming.source import RetryingSource
+
+    tel = telemetry.configure(True)
+    try:
+        src = RetryingSource(
+            BatchSource(farmer.build_batch(8)), retries=6,
+            backoff=0.0005, backoff_cap=0.002,
+            chaos=ChaosInjector({"block_build_fail": 6}),
+            jitter=0.5, jitter_seed=7)
+        b = src.block(np.arange(2))
+        assert b.num_scens == 2
+        delays = [r["delay"] for r in src.retry_log]
+        assert len(delays) == 6
+        # capped: jitter may spread a delay but never past backoff_cap
+        assert all(0.0 <= d <= 0.002 for d in delays)
+        # jittered: the observed delays are NOT the deterministic ladder
+        ladder = [restart_delay(a, 0.0005, 0.002) for a in range(1, 7)]
+        assert delays != ladder
+        # attempts 3..6 all sit on the capped ladder rung (0.002) —
+        # with jitter their delays still disagree with each other
+        assert len({round(d, 9) for d in delays[2:]}) > 1
+        assert telemetry.stream_counters(tel.registry)[
+            "stream_source_retries"] == 6
+    finally:
+        telemetry.reset()
+
+
+def test_retrying_source_jitter_zero_reproduces_ladder():
+    """jitter=0 is the escape hatch: delays collapse back to the exact
+    supervisor restart ladder (the pre-jitter behaviour)."""
+    from mpisppy_tpu.resilience.chaos import ChaosInjector
+    from mpisppy_tpu.resilience.supervisor import restart_delay
+    from mpisppy_tpu.streaming.source import RetryingSource
+
+    src = RetryingSource(
+        BatchSource(farmer.build_batch(8)), retries=3,
+        backoff=0.0005, backoff_cap=0.002,
+        chaos=ChaosInjector({"block_build_fail": 3}), jitter=0)
+    src.block(np.arange(2))
+    assert [r["delay"] for r in src.retry_log] == [
+        restart_delay(a, 0.0005, 0.002) for a in range(1, 4)]
 
 
 def test_streaming_ph_wires_source_retries_from_options():
