@@ -686,6 +686,148 @@ def worker_farmer_stream():
     print(json.dumps(out))
 
 
+def worker_farmer_shard():
+    """BENCH_MODEL=farmer_shard: StreamingPH over a DURABLE on-disk
+    shard corpus (mpisppy_tpu/streaming/store.py) instead of the
+    in-process generator — export the farmer universe once as
+    checksummed fixed-width shard files, then stream sampled blocks
+    back through the bounded readahead prefetcher with every read
+    CRC+header validated.  Default S=4096 scenarios in shards of
+    BENCH_SHARD_WIDTH (default 64); BENCH_SHARD_CHAOS=1 (default)
+    additionally runs the four storage chaos modes (io_delay,
+    io_error, shard_corrupt, shard_missing) and reports the degraded
+    run's quarantine accounting.  `value` is the wall-clock to the
+    certified CI of the HEALTHY run, -1 if uncertified.  The JSON
+    carries the storage-specific fields: readahead_hit_rate,
+    read_wait_seconds (time the gather actually blocked on disk),
+    shards_quarantined, quarantined_frac (chaos run), and
+    source_retries_total."""
+    import shutil
+    import tempfile
+
+    from mpisppy_tpu.utils.platform import (enable_f64_if_cpu,
+                                            ensure_cpu_backend)
+    ensure_cpu_backend()
+    enable_f64_if_cpu()
+
+    from mpisppy_tpu import telemetry
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.streaming import ShardSource
+    from mpisppy_tpu.streaming.streaming_ph import StreamingPH
+
+    S = int(os.environ.get("BENCH_SCENS", 4096))
+    width = int(os.environ.get("BENCH_SHARD_WIDTH", 64))
+    block = int(os.environ.get("BENCH_BLOCK", 256))
+    iters = int(os.environ.get("BENCH_STREAM_ITERS", 60))
+    rule = os.environ.get("BENCH_STREAM_RULE", "BM")
+    telemetry.configure(True)
+
+    corpus = tempfile.mkdtemp(prefix="farmer_shard_")
+    t_export0 = time.time()
+    farmer.export_corpus(corpus, S, shard_width=width)
+    export_s = time.time() - t_export0
+
+    def opts(**kw):
+        o = {"defaultPHrho": 1.0, "PHIterLimit": iters,
+             "solver_eps": 1e-5, "superstep_eps": 1e-4,
+             "pdhg_max_iters": 30000,
+             "stream_block_size": block,
+             "stream_check_every": int(
+                 os.environ.get("BENCH_STREAM_CHECK", 5)),
+             "stopping_criterion": rule,
+             "BM_h": float(os.environ.get("BENCH_BM_H", 2.0)),
+             "BM_hprime": float(os.environ.get("BENCH_BM_HPRIME",
+                                               0.35)),
+             "BM_eps": float(os.environ.get("BENCH_BM_EPS", 200.0)),
+             "telemetry": True}
+        o.update(kw)
+        return o
+
+    try:
+        src = ShardSource(corpus, depth=int(
+            os.environ.get("BENCH_SHARD_DEPTH", 4)))
+        sph = StreamingPH(opts(), src, module=farmer)
+        t0 = time.time()
+        conv, eobj, trivial = sph.stream_main()
+        wall = time.time() - t0
+        st = sph.stream_stats()
+        counters = telemetry.storage_counters()
+        stream_ctr = telemetry.stream_counters()
+        stats = sph.solve_stats()
+        certified = sph.certified is not None
+        storage = st.get("storage", {})
+        out = {
+            "metric": f"farmer_shard{S}_ph_seconds_to_certified_ci",
+            "value": round(wall, 3) if certified else -1,
+            "unit": "s", "vs_baseline": 0,
+            "corpus_export_seconds": round(export_s, 3),
+            "shard_width": width,
+            "n_shards": src.store.n_shards,
+            "readahead_hit_rate": round(
+                storage.get("readahead_hit_rate", 0.0), 4),
+            "read_wait_seconds": round(
+                storage.get("read_wait_seconds", 0.0), 4),
+            "shards_quarantined": storage.get("shards_quarantined", 0),
+            "quarantined_frac": storage.get("quarantined_frac", 0.0),
+            "source_retries_total": stream_ctr["stream_source_retries"],
+            "sampled_scenarios": st["sampled_scenarios"],
+            "prefetch_wait_seconds": round(
+                st["prefetch_wait_seconds"], 4),
+            "ci_gap": st["ci_gap"],
+            "certified": certified,
+            "stopping_criterion": rule,
+            "supersteps": st["supersteps"],
+            "block_width": st["block_width"],
+            "blocks_loaded": st["blocks_loaded"],
+            "eobj": round(float(eobj), 3),
+            "trivial_bound_estimate": round(float(trivial), 3),
+            "conv": round(float(conv), 6),
+            "kernel_dtype": stats["dtype"],
+            "device": stats["device"], "scens": S,
+            **counters}
+        if not certified:
+            out["note"] = (f"uncertified after {st['supersteps']} "
+                           f"supersteps (rule {rule})")
+
+        if os.environ.get("BENCH_SHARD_CHAOS", "1") != "0":
+            # degraded rerun: all four storage chaos modes against the
+            # SAME corpus — transient io faults must recover, the
+            # corrupt/missing shards must quarantine, and the certified
+            # CI must carry the lost-mass debit
+            # fault the LAST TWO shards of the Iter0 sweep prefix so
+            # the run provably hits them (faulting shards the sampler
+            # never touches would inject nothing)
+            n0 = min(S, 4 * block)
+            hi_sid = max((n0 - 1) // width, 1)
+            telemetry.configure(True)
+            csrc = ShardSource(
+                corpus, depth=4, max_shard_retries=2, backoff=0.01,
+                max_quarantined_frac=0.5,
+                chaos={"io_delay": 0.001, "io_error": 2,
+                       "shard_corrupt": [hi_sid - 1],
+                       "shard_missing": hi_sid})
+            csph = StreamingPH(opts(n0min=n0), csrc, module=farmer)
+            t1 = time.time()
+            csph.stream_main(finalize=False)
+            cst = csph.stream_stats()
+            cstor = cst.get("storage", {})
+            cert = csph.certified
+            out.update({
+                "chaos_wall_seconds": round(time.time() - t1, 3),
+                "chaos_certified": cert is not None,
+                "chaos_shards_quarantined": cstor.get(
+                    "shards_quarantined", 0),
+                "chaos_quarantined_frac": cstor.get(
+                    "quarantined_frac", 0.0),
+                "chaos_gap_debit": (round(cert["gap_debit"], 3)
+                                    if cert else None),
+                "chaos_read_retries": cstor.get("read_retries", 0),
+            })
+    finally:
+        shutil.rmtree(corpus, ignore_errors=True)
+    print(json.dumps(out))
+
+
 def worker_wheel_mpmd():
     """BENCH_MODEL=wheel_mpmd: the device-resident MPMD wheel
     (mpisppy_tpu/mpmd/) — hub + Lagrangian + xhat cylinders on
@@ -819,6 +961,8 @@ def worker():
         return worker_serve()
     if model == "farmer_stream":
         return worker_farmer_stream()
+    if model == "farmer_shard":
+        return worker_farmer_shard()
     if model == "wheel_mpmd":
         return worker_wheel_mpmd()
     import numpy as np

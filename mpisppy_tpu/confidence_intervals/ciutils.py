@@ -187,3 +187,28 @@ def gap_estimators(xhat_one, mname_or_module, solving_type="EF_2stage",
     if objective_gap:
         out["Gobj"] = zhat - zstar
     return out
+
+
+def debit_quarantined_mass(est, frac):
+    """Debit lost scenario mass into a gap estimate, in place.
+
+    When a shard store quarantines unreadable shards
+    (streaming/store.py), `frac` of the scenario universe was replaced
+    by resampled draws from the healthy remainder.  The sampled-gap
+    point estimate is then conditioned on the readable sub-universe;
+    the unread mass could hide up to `frac * |z|` of objective, so the
+    certificate must widen by that much rather than silently claim the
+    healthy-corpus verdict.  Scales by the LARGEST objective magnitude
+    in the estimate (floored at 1.0 for near-zero objectives), adds
+    the debit to est["G"], records it under est["quarantine_debit"],
+    and returns the debit.  frac <= 0 is a no-op returning 0.0 — a
+    healthy run's estimate is bit-untouched."""
+    frac = float(frac)
+    if frac <= 0.0:
+        return 0.0
+    scale = max(abs(float(est.get("zhats", 0.0))),
+                abs(float(est.get("zstar", 0.0))), 1.0)
+    debit = frac * scale
+    est["G"] = float(est["G"]) + debit
+    est["quarantine_debit"] = debit
+    return debit

@@ -171,11 +171,13 @@ def run_spoke_from_spec(specfile: str) -> int:
         # re-pairing), so it must never observe a half-written file.
         # np.save on a FILE OBJECT keeps the name verbatim (the path
         # form would append .npy to the .tmp suffix).
+        import io
+
+        from ..resilience.checkpoint import atomic_write
         final = w["prefix"] + ".sol.npy"
-        tmp = final + ".tmp"
-        with open(tmp, "wb") as f:
-            np.save(f, np.asarray(sol))
-        os.replace(tmp, final)
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(sol))
+        atomic_write(final, buf.getvalue())
     spoke.finalize()
     if tel.enabled:
         tp = tel.config.get("trace_path")

@@ -242,6 +242,17 @@ def scenario_source(num_scens, cfg=None):
         name_fn=lambda i: f"scen{i}")
 
 
+def export_corpus(path, num_scens, shard_width=64, cfg=None):
+    """Persist the farmer scenario universe as a durable shard corpus
+    (streaming/store.py): checksummed fixed-width shard files a
+    `ShardSource` can stream back without this module's generator.
+    Returns the corpus path."""
+    from ..streaming import write_corpus
+    return write_corpus(
+        scenario_source(num_scens, cfg), path, shard_width,
+        meta={"name_format": "scen{i}"})
+
+
 def scenario_creator(scenario_name, use_integer=False, sense=1,
                      crops_multiplier=1, num_scens=None, seedoffset=0):
     """Single-scenario creator through the declarative LinearModel API —

@@ -294,6 +294,17 @@ def scenario_source(num_scens, cfg=None):
         name_fn=lambda i: f"Scenario{i+1}")
 
 
+def export_corpus(path, num_scens, shard_width=64, cfg=None):
+    """Persist the UC wind universe as a durable shard corpus
+    (streaming/store.py).  shared_A blocks stay shared on disk — the
+    corpus stores one (1, M, N) matrix per shard, never a per-scenario
+    replica.  Returns the corpus path."""
+    from ..streaming import write_corpus
+    return write_corpus(
+        scenario_source(num_scens, cfg), path, shard_width,
+        meta={"name_format": "Scenario{i1}"})
+
+
 def scenario_names_creator(num_scens, start=0):
     return [f"Scenario{i+1}" for i in range(start, start + num_scens)]
 

@@ -25,8 +25,9 @@ See doc/src/resilience.md for the operator-facing story.
 
 from .bounds import BoundGuard
 from .chaos import ChaosError, ChaosInjector
-from .checkpoint import (checkpoint_exists, load_run_checkpoint,
-                         restore_hub, save_run_checkpoint)
+from .checkpoint import (atomic_write, checkpoint_exists,
+                         load_run_checkpoint, restore_hub,
+                         save_run_checkpoint)
 from .supervisor import SpokeSupervisor, restart_delay
 
 
@@ -46,6 +47,7 @@ def wheel_counters(opt_or_hub):
 
 __all__ = [
     "BoundGuard", "ChaosError", "ChaosInjector", "SpokeSupervisor",
-    "checkpoint_exists", "load_run_checkpoint", "restart_delay",
-    "restore_hub", "save_run_checkpoint", "wheel_counters",
+    "atomic_write", "checkpoint_exists", "load_run_checkpoint",
+    "restart_delay", "restore_hub", "save_run_checkpoint",
+    "wheel_counters",
 ]

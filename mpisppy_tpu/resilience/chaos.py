@@ -71,6 +71,24 @@ class ChaosInjector:
                            and hang pruning fires)
       block_build_fail: int streaming: fail the first N source block
                            builds (retry/backoff tests)
+      io_delay: float      shard store: sleep before every shard read
+                           attempt (slow-storage injection — feeds the
+                           store.read_wait_seconds telemetry)
+      io_error: int        shard store: raise OSError on the first N
+                           shard read attempts (TRANSIENT — the
+                           store's capped-backoff retry must recover
+                           without quarantining anything)
+      shard_corrupt: ids   shard store: flip payload bytes of these
+                           shard ids after every disk read (int or
+                           list).  The stored CRC stays HONEST (it
+                           covers the true bytes), so read_checked's
+                           checksum validation — not value hygiene —
+                           must reject the shard; persistent, so the
+                           retry budget exhausts and the shard is
+                           quarantined
+      shard_missing: ids   shard store: reads of these shard ids raise
+                           FileNotFoundError (int or list; persistent
+                           -> quarantine, like shard_corrupt)
       replica_crash: int   serve replica: raise ChaosError on EVERY
                            dispatch from the N-th on (exhausts the
                            service's worker-restart budget so the
@@ -94,6 +112,7 @@ class ChaosInjector:
         self.steps = 0
         self.writes = 0
         self.builds = 0
+        self.shard_reads = 0
 
     @classmethod
     def from_options(cls, config=None):
@@ -202,6 +221,49 @@ class ChaosInjector:
         if self.builds <= int(n):
             raise ChaosError(
                 f"injected block build failure {self.builds}/{int(n)}")
+
+    # -- shard-store-side -------------------------------------------------
+    @staticmethod
+    def _sid_set(v):
+        """Normalize an id config value (int or iterable) to a set."""
+        if v is None:
+            return set()
+        if isinstance(v, (int, float)):
+            return {int(v)}
+        return {int(s) for s in v}
+
+    def shard_read_tick(self, sid):
+        """One shard read ATTEMPT (the store's retry loop re-enters
+        here per attempt): injected storage latency (io_delay), a
+        transient I/O fault for the first `io_error` attempts, and the
+        persistent missing-file fault for `shard_missing` ids."""
+        if not self.config:
+            return
+        self.shard_reads += 1
+        c = self.config
+        d = float(c.get("io_delay", 0) or 0)
+        if d > 0:
+            time.sleep(d)
+        if int(sid) in self._sid_set(c.get("shard_missing")):
+            raise FileNotFoundError(
+                f"injected missing shard {int(sid)}")
+        n = c.get("io_error")
+        if n and self.shard_reads <= int(n):
+            raise OSError(
+                f"injected io error on shard read "
+                f"{self.shard_reads}/{int(n)}")
+
+    def corrupt_shard_bytes(self, sid, data):
+        """Flip the LAST byte of a shard file image when `sid` is in
+        shard_corrupt — always inside the payload region, so the
+        header parses but the HONEST stored CRC (computed over the
+        true bytes) no longer matches: checksum validation, not value
+        hygiene, must catch it."""
+        if int(sid) not in self._sid_set(self.config.get("shard_corrupt")):
+            return data
+        if not data:
+            return data
+        return data[:-1] + bytes([data[-1] ^ 0xFF])
 
     # -- hub-side ---------------------------------------------------------
     def hub_iter_tick(self, k):

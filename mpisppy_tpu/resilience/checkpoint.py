@@ -18,9 +18,25 @@ mid-write) never sees a torn file.
 
 from __future__ import annotations
 
+import io
 import os
 
 import numpy as np
+
+
+def atomic_write(path, data):
+    """Atomically write `data` (bytes) to `path` via tmp-file +
+    os.replace: a reader — or a resume after a crash mid-write — never
+    sees a torn file.  The ONE tmp-rename discipline shared by run/
+    wheel/stream checkpoints (`_atomic_savez`), the W/xbar snapshot
+    (utils/wxbarutils.py), the spoke solution publish
+    (cylinders/proc.py), and the shard corpus (streaming/store.py)."""
+    path = str(path)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return path
 
 
 def _norm_npz(path):
@@ -43,16 +59,12 @@ def _opt_load(v):
 
 
 def _atomic_savez(path, payload):
-    """Write `payload` as <path>.npz via tmp-file + os.replace, so a
-    reader (or a resume after a crash mid-write) never sees a torn
-    file.  savez on a FILE OBJECT keeps the name verbatim (the path
-    form appends .npz, which would break the replace pairing)."""
-    real = _norm_npz(path)
-    tmp = real + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez_compressed(f, **payload)
-    os.replace(tmp, real)
-    return real
+    """Write `payload` as <path>.npz through `atomic_write`.  savez on
+    a FILE OBJECT keeps the name verbatim (the path form appends .npz,
+    which would break the replace pairing)."""
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **payload)
+    return atomic_write(_norm_npz(path), buf.getvalue())
 
 
 def _run_payload(opt):
@@ -205,6 +217,13 @@ def save_stream_checkpoint(path, sph):
         "warm_y": (np.asarray(warm[1]) if warm is not None
                    else np.array([])),
     }
+    # storage cursor (shard-backed sources): the quarantine set and
+    # retry/resample state — substitution is a pure function of
+    # (indices, quarantine set), so restoring this set is what makes
+    # the resumed run replay quarantine substitutions bit-equally
+    store = getattr(sph, "_shard_store", lambda: None)()
+    if store is not None:
+        payload["storage_cursor"] = np.array(json.dumps(store.state()))
     return _atomic_savez(path, payload)
 
 
@@ -256,6 +275,9 @@ def load_stream_checkpoint(path, sph):
     wx = np.asarray(z["warm_x"])
     sph._warm_host = ((wx, np.asarray(z["warm_y"])) if wx.size
                       else None)
+    store = getattr(sph, "_shard_store", lambda: None)()
+    if store is not None and "storage_cursor" in z:
+        store.restore(json.loads(str(z["storage_cursor"])))
     sph._install_resumed_state(int(z["it"]))
     return z
 

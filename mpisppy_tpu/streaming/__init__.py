@@ -9,27 +9,46 @@ runs randomized PH supersteps over sampled blocks with full-S dual
 weights host-resident — stopping when the gap estimate certifies a
 confidence interval.  doc/src/streaming.md is the chapter.
 
-Import layering (AST-guarded in tests/test_streaming.py): this package
-and its host-path modules (source, stream, sampler) never import jax
-at module level — `StreamingPH` itself is loaded lazily on first
+At storage scale, `write_corpus` persists a source's universe as
+checksummed fixed-width shard files and `ShardSource` streams sampled
+blocks back off disk through a bounded readahead, with per-shard
+retry/quarantine and certified-gap accounting for lost mass
+(store.py / readahead.py — the durable-corpus layer).
+
+Import layering (AST-guarded in tests/test_streaming.py and
+tests/test_shard_store.py): this package and its host-path modules
+(source, stream, sampler, store, readahead) never import jax at
+module level — `StreamingPH` itself is loaded lazily on first
 attribute access.
 """
 
+from .readahead import ReadaheadCache, ShardSource
 from .sampler import AdaptiveSampler
 from .source import (BatchSource, GeneratorSource, ScenarioSource,
                      gather_block, source_for_module)
+from .store import (QuarantinedCorpusError, ShardIntegrityError,
+                    ShardQuarantinedError, ShardStore, ShardStoreError,
+                    write_corpus)
 from .stream import ScenarioStream, StreamClosed
 
 __all__ = [
     "AdaptiveSampler",
     "BatchSource",
     "GeneratorSource",
+    "QuarantinedCorpusError",
+    "ReadaheadCache",
     "ScenarioSource",
     "ScenarioStream",
+    "ShardIntegrityError",
+    "ShardQuarantinedError",
+    "ShardSource",
+    "ShardStore",
+    "ShardStoreError",
     "StreamClosed",
     "StreamingPH",
     "gather_block",
     "source_for_module",
+    "write_corpus",
 ]
 
 

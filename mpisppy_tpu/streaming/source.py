@@ -166,16 +166,36 @@ class BatchSource(ScenarioSource):
 
 class SourceBuildError(RuntimeError):
     """A scenario block could not be built within the retry budget.
-    Carries the structured failure context (the index set, attempt
-    count, and the last underlying error) so drivers can log/requeue
+    Carries the structured failure context — the index set, attempt
+    count, the last underlying error, and the full per-attempt
+    `retry_state` (attempt number, error string, backoff delay, as
+    recorded by RetryingSource.retry_log) — so drivers can log/requeue
     the block instead of parsing a message string."""
 
-    def __init__(self, message, indices=None, attempts=0, last_error=None):
+    def __init__(self, message, indices=None, attempts=0, last_error=None,
+                 retry_state=()):
         super().__init__(message)
         self.indices = (tuple(int(i) for i in np.asarray(indices).ravel())
                         if indices is not None else ())
         self.attempts = int(attempts)
         self.last_error = last_error
+        # the attempt/backoff ladder the wrapper actually walked —
+        # one {"attempt", "error", "delay"} dict per retried attempt
+        self.retry_state = tuple(dict(r) for r in retry_state)
+
+
+def backoff_delay(attempt, backoff, backoff_cap, jitter=0.0, rng=None):
+    """The supervisor restart-ladder value for `attempt`, spread by
+    multiplicative +/- `jitter` and re-capped (jitter never pushes a
+    delay past backoff_cap).  The ONE backoff policy shared by
+    RetryingSource (transient block-build failures) and the shard
+    store's read retries (streaming/store.py)."""
+    from ..resilience.supervisor import restart_delay
+    base = restart_delay(attempt, backoff, backoff_cap)
+    if jitter <= 0 or rng is None:
+        return base
+    spread = base * rng.uniform(-jitter, jitter)
+    return min(backoff_cap, max(0.0, base + spread))
 
 
 class RetryingSource(ScenarioSource):
@@ -208,27 +228,30 @@ class RetryingSource(ScenarioSource):
         self.retry_log = []
 
     def _delay(self, attempt):
-        """The supervisor ladder value, spread by +/- jitter and
-        re-capped (jitter never pushes a delay past backoff_cap)."""
-        from ..resilience.supervisor import restart_delay
-        base = restart_delay(attempt, self.backoff, self.backoff_cap)
-        if self.jitter <= 0:
-            return base
-        spread = base * self._rng.uniform(-self.jitter, self.jitter)
-        return min(self.backoff_cap, max(0.0, base + spread))
+        return backoff_delay(attempt, self.backoff, self.backoff_cap,
+                             self.jitter, self._rng)
 
-    def block(self, indices):
+    def _with_retries(self, fn, indices):
+        """Run `fn()` under the capped-backoff retry loop.  Every
+        retry increments stream.source_retries; a terminal give-up
+        increments stream.source_giveups (retries alone would leave
+        exhaustion invisible to telemetry) and raises the structured
+        SourceBuildError carrying this call's retry ladder."""
         import time
 
         from .. import telemetry as _telemetry
 
+        log_start = len(self.retry_log)
         last = None
         for attempt in range(1, self.retries + 2):
             try:
                 if self.chaos is not None:
                     self.chaos.block_build_tick()
-                return self.inner.block(indices)
+                return fn()
             except Exception as e:
+                if getattr(e, "non_retryable", False):
+                    raise      # terminal by contract (e.g. a corpus
+                    #            past its quarantine budget)
                 last = e
                 if attempt > self.retries:
                     break
@@ -238,11 +261,38 @@ class RetryingSource(ScenarioSource):
                      "delay": delay})
                 _telemetry.get().counter("stream.source_retries").inc()
                 time.sleep(delay)
+        _telemetry.get().counter("stream.source_giveups").inc()
         raise SourceBuildError(
             f"scenario block build failed after {self.retries} "
             f"retr{'y' if self.retries == 1 else 'ies'}: {last}",
             indices=indices, attempts=self.retries + 1,
-            last_error=last)
+            last_error=last, retry_state=self.retry_log[log_start:])
+
+    def block(self, indices):
+        return self._with_retries(lambda: self.inner.block(indices),
+                                  indices)
+
+    def block_with_indices(self, indices):
+        """Delegates the served-indices protocol (a quarantining
+        ShardSource may substitute unreadable indices; the stream must
+        absorb the block under the indices actually served)."""
+        fn = getattr(self.inner, "block_with_indices", None)
+        if fn is None:
+            return (np.asarray(indices, dtype=np.int64),
+                    self.block(indices))
+        return self._with_retries(lambda: fn(indices), indices)
+
+    def note_upcoming(self, indices):
+        """Readahead hint pass-through (no retry semantics: a hint is
+        best-effort)."""
+        fn = getattr(self.inner, "note_upcoming", None)
+        if fn is not None:
+            fn(indices)
+
+    def close(self):
+        fn = getattr(self.inner, "close", None)
+        if fn is not None:
+            fn()
 
     def names(self, indices):
         return self.inner.names(indices)

@@ -68,7 +68,14 @@ class ScenarioStream:
                 return
             indices = item
             try:
-                block = self.source.block(indices)
+                # served-indices protocol: a quarantining ShardSource
+                # may substitute unreadable indices — the consumer must
+                # absorb the block under the indices ACTUALLY served
+                fn = getattr(self.source, "block_with_indices", None)
+                if fn is not None:
+                    indices, block = fn(indices)
+                else:
+                    block = self.source.block(indices)
                 if self.transfer is not None:
                     block = self.transfer(block)
                 self._out.put((indices, block, None))

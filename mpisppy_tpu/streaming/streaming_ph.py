@@ -172,6 +172,32 @@ class StreamingPH(PHBase):
         self.stream = ScenarioStream(source, transfer=_transfer,
                                      telemetry=self._tel)
 
+    # -- storage plumbing --------------------------------------------------
+    def _shard_store(self):
+        """The ShardStore behind this run's source, unwrapping retry
+        wrappers — None for generator/batch sources.  Feeds the
+        certified-gap quarantine debit, the stream checkpoint's
+        storage cursor, and stream_stats."""
+        src = self.source
+        for _ in range(8):
+            store = getattr(src, "store", None)
+            if store is not None:
+                return store
+            inner = getattr(src, "inner", None)
+            if inner is None:
+                return None
+            src = inner
+        return None
+
+    def _prefetch(self, indices):
+        """Prefetch an index set, hinting the source's readahead first
+        (a shard-backed source starts its disk reads before the stream
+        worker even dequeues the build)."""
+        hint = getattr(self.source, "note_upcoming", None)
+        if hint is not None:
+            hint(indices)
+        self.stream.prefetch(indices)
+
     # -- invalid inherited surfaces ---------------------------------------
     def check_W_bound_supported(self):
         raise NotImplementedError(
@@ -299,12 +325,12 @@ class StreamingPH(PHBase):
                    f"{self.total_scens} scenarios in blocks of {bsz}")
         chunks = [np.arange(i, min(i + bsz, n0))
                   for i in range(0, n0, bsz)]
-        self.stream.prefetch(chunks[0])
+        self._prefetch(chunks[0])
         dual_sum = 0.0
         res = blk = None
         for j in range(len(chunks)):
             if j + 1 < len(chunks):
-                self.stream.prefetch(chunks[j + 1])
+                self._prefetch(chunks[j + 1])
             idx, blk = self.stream.next_block()
             res = self.solve_loop(
                 warm=False, batch=blk, prep=self._block_prep(blk),
@@ -327,7 +353,7 @@ class StreamingPH(PHBase):
         self._install_state(res, blk, it=0)
         # draw + prefetch the first sampled block (RNG consumption #1)
         self._pending_indices = self.sampler.draw_block()
-        self.stream.prefetch(self._pending_indices)
+        self._prefetch(self._pending_indices)
         global_toc(f"StreamingPH Iter0 sampled trivial bound = "
                    f"{self.trivial_bound:.6g}, conv = {self.conv:.6g}")
         if self._tel.enabled:
@@ -347,7 +373,7 @@ class StreamingPH(PHBase):
         # and transfer overlap this solve (double-buffering); growth
         # from this superstep's certification takes effect at k+2
         self._pending_indices = self.sampler.draw_block()
-        self.stream.prefetch(self._pending_indices)
+        self._prefetch(self._pending_indices)
 
         b = idx.size
         dt = self.batch.c.dtype
@@ -409,22 +435,34 @@ class StreamingPH(PHBase):
                        f"({e}); continuing")
             return False
         self._est_seed = int(est["seed"])
+        # quarantined-corpus accounting: resampled (lost) scenario
+        # mass widens the gap estimate BEFORE the stopping rule sees
+        # it — a degraded corpus must work harder to certify, and the
+        # reported CI carries the debit explicitly.  frac == 0 (no
+        # store, or a healthy one) leaves the estimate bit-untouched.
+        store = self._shard_store()
+        q_frac = float(store.quarantined_frac) if store is not None \
+            else 0.0
+        debit = ciutils.debit_quarantined_mass(est, q_frac)
         G, s = float(est["G"]), float(est["std"])
         self._est_history.append([nk, G, s])
         self._last_zhats = float(est["zhats"])
         stop = self.sampler.observe(G, s)
         global_toc(f"stream certify: n={nk} G={G:.6g} s={s:.6g} "
-                   f"stop={stop} active_n={self.sampler.active_n}")
+                   f"stop={stop} active_n={self.sampler.active_n}"
+                   + (f" quarantine_debit={debit:.6g}" if debit else ""))
         if self._tel.enabled:
             self._tel.event("stream.certify", n=nk, G=G, s=s,
-                            stop=bool(stop))
+                            stop=bool(stop), quarantine_debit=debit)
         if stop:
             self.certified = {
                 "G": G, "s": s, "num_scens": nk,
-                "CI": [0.0, self.rule.ci_upper(s)],
+                "CI": [0.0, self.rule.ci_upper(s) + debit],
                 "zhats": self._last_zhats,
                 "T": int(self.sampler.est_rounds),
                 "criterion": self.rule.stopping_criterion,
+                "quarantined_frac": q_frac,
+                "gap_debit": debit,
             }
             return True
         return False
@@ -439,8 +477,9 @@ class StreamingPH(PHBase):
         load_stream_checkpoint(path, self)
         # blocks are pure functions of their index set: re-issuing the
         # pending prefetch rebuilds exactly the block the crashed run
-        # had in flight
-        self.stream.prefetch(self._pending_indices)
+        # had in flight (the storage cursor was restored first, so a
+        # shard-backed source replays the same substitutions)
+        self._prefetch(self._pending_indices)
         global_toc(f"StreamingPH resumed from {path} at superstep "
                    f"{int(self.state.it)} "
                    f"(active_n={self.sampler.active_n})")
@@ -471,6 +510,9 @@ class StreamingPH(PHBase):
             trivial = self.Iter0()
         self.iterk_loop()
         self.stream.close()
+        closer = getattr(self.source, "close", None)
+        if closer is not None:
+            closer()          # stop a shard source's readahead worker
         if finalize:
             eobj = self.post_loops()
             ci = self.certified["CI"] if self.certified else None
@@ -483,7 +525,7 @@ class StreamingPH(PHBase):
         """Streaming run facts for bench.py / callers."""
         st = self.stream.stats()
         steps = int(self.state.it) if self.state is not None else 0
-        return {
+        out = {
             "sampled_scenarios": int(self.sampler.active_n),
             "total_scens": int(self.total_scens),
             "block_width": int(self.block_width),
@@ -498,3 +540,7 @@ class StreamingPH(PHBase):
             "est_history": list(self._est_history),
             **st,
         }
+        src_stats = getattr(self.source, "stats", None)
+        if src_stats is not None:
+            out["storage"] = src_stats()
+        return out

@@ -232,7 +232,7 @@ def stream_counters(registry=None):
     reg = registry if registry is not None else get().registry
     names = ("stream.blocks_loaded", "stream.scenarios_streamed",
              "stream.sample_growth_events", "stream.supersteps",
-             "stream.source_retries")
+             "stream.source_retries", "stream.source_giveups")
     vals = ({k: c.value for k, c in reg._counters.items()}
             if reg.enabled else {})
     out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
@@ -243,6 +243,32 @@ def stream_counters(registry=None):
     h = (reg._histograms.get("stream.prefetch_wait_seconds")
          if reg.enabled else None)
     out["stream_prefetch_wait_seconds"] = (
+        float(h.total) if h is not None else 0.0)
+    return out
+
+
+def storage_counters(registry=None):
+    """Shard-store counter dict for bench JSON (zeros when the run had
+    telemetry off — keys are stable either way): shards read/
+    quarantined, read retries, resampled indices, readahead hit/miss
+    traffic, plus the quarantined-mass and hit-rate gauges and the
+    total seconds the reader spent blocked on shard loads
+    (store.read_wait_seconds — ~0 when the readahead fully overlaps
+    gathers and solves)."""
+    reg = registry if registry is not None else get().registry
+    names = ("store.shards_read", "store.read_retries",
+             "store.shards_quarantined", "store.resampled_indices",
+             "store.readahead_hits", "store.readahead_misses")
+    vals = ({k: c.value for k, c in reg._counters.items()}
+            if reg.enabled else {})
+    out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
+    for gname in ("store.quarantined_frac", "store.readahead_hit_rate"):
+        g = reg._gauges.get(gname) if reg.enabled else None
+        out[gname.replace(".", "_")] = (
+            float(g.value) if g is not None else 0.0)
+    h = (reg._histograms.get("store.read_wait_seconds")
+         if reg.enabled else None)
+    out["store_read_wait_seconds"] = (
         float(h.total) if h is not None else 0.0)
     return out
 

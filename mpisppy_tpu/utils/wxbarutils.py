@@ -24,23 +24,22 @@ def _norm_npz(path):
 
 def write_W_and_xbar(path, opt):
     """Persist the current PH dual state (reference ROOT usage:
-    WXBarWriter extension).  Atomic: written to a tmp file and
-    os.replace'd, so a reader — or a resume after a crash mid-write —
-    never sees a torn checkpoint."""
+    WXBarWriter extension).  Atomic through the one shared tmp-rename
+    helper (resilience.checkpoint.atomic_write); savez on a FILE
+    OBJECT keeps the name verbatim (the path form would append .npz)."""
+    import io
+
+    from ..resilience.checkpoint import atomic_write
     st = opt.state
-    real = _norm_npz(path)
-    tmp = real + ".tmp"
-    # savez on a FILE OBJECT keeps the name verbatim (the path form
-    # would append .npz to the .tmp suffix)
-    with open(tmp, "wb") as f:
-        np.savez_compressed(
-            f,
-            W=np.asarray(st.W), xbar=np.asarray(st.xbar),
-            nonant_names=np.array(opt.batch.tree.nonant_names,
-                                  dtype=object)
-            if opt.batch.tree.nonant_names else np.array([], dtype=object),
-            it=int(st.it))
-    os.replace(tmp, real)
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf,
+        W=np.asarray(st.W), xbar=np.asarray(st.xbar),
+        nonant_names=np.array(opt.batch.tree.nonant_names,
+                              dtype=object)
+        if opt.batch.tree.nonant_names else np.array([], dtype=object),
+        it=int(st.it))
+    atomic_write(_norm_npz(path), buf.getvalue())
 
 
 def read_W_and_xbar(path, opt):

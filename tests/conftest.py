@@ -57,3 +57,8 @@ def pytest_configure(config):
         "plans, device mailboxes, seqlock parity, slice supervision) "
         "on the faked 8-device fleet; these RUN under tier-1's "
         "`-m 'not slow'`")
+    config.addinivalue_line(
+        "markers", "storage: durable shard-store tests (checksummed "
+        "corpus, readahead, quarantine + certified-gap accounting, "
+        "storage-cursor resume); these RUN under tier-1's "
+        "`-m 'not slow'`")

@@ -1,0 +1,436 @@
+"""Durable shard store tests (PR 14): checksummed corpus round-trip,
+header/CRC validation on every read, transient-fault retry, shard
+quarantine with deterministic resampling and certified-gap debit, the
+hard-fail quarantine budget, readahead hit/wait accounting, the
+storage cursor's checkpoint round-trip, and the chaos e2e — a
+StreamingPH run over a faulted corpus reaching a certified stop whose
+CI carries the lost-mass debit, plus mid-superstep crash-resume
+bit-equality.  Also the laziness guards: store.py/readahead.py never
+import jax at module level (AST + fresh interpreter)."""
+
+import ast
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import zlib
+
+import numpy as np
+import pytest
+
+import mpisppy_tpu.streaming as streaming_pkg
+from mpisppy_tpu import telemetry
+from mpisppy_tpu.models import farmer, uc
+from mpisppy_tpu.resilience.checkpoint import atomic_write
+from mpisppy_tpu.streaming import (QuarantinedCorpusError,
+                                   ReadaheadCache, ShardIntegrityError,
+                                   ShardQuarantinedError, ShardSource,
+                                   ShardStore, write_corpus)
+from mpisppy_tpu.streaming.store import MAGIC, _decode_shard
+
+pytestmark = pytest.mark.storage
+
+
+@pytest.fixture
+def farmer_corpus(tmp_path):
+    """A 64-scenario farmer corpus in 8-wide shards (split-native A)."""
+    path = os.fspath(tmp_path / "corpus")
+    farmer.export_corpus(path, 64, shard_width=8)
+    return path
+
+
+# ---- format round-trip ----------------------------------------------------
+
+def test_corpus_roundtrip_parity_with_generator(farmer_corpus):
+    """Blocks served off disk are bit-identical to generator-built
+    blocks — arrays, SplitA structure, names, block-uniform probs."""
+    src = farmer.scenario_source(64, {})
+    ss = ShardSource(farmer_corpus, depth=2)
+    idx = np.array([1, 5, 9, 17, 23, 63])
+    served, blk = ss.block_with_indices(idx)
+    ref = src.block(idx)
+    assert np.array_equal(served, idx)
+    for f in ("c", "row_lo", "row_hi", "lb", "ub", "obj_const",
+              "nonant_idx"):
+        assert np.array_equal(np.asarray(getattr(blk, f)),
+                              np.asarray(getattr(ref, f))), f
+    # split-native A survives the disk trip: shared matrix + deltas
+    assert type(blk.A).__name__ == "SplitA"
+    assert np.array_equal(np.asarray(blk.A.shared),
+                          np.asarray(ref.A.shared))
+    assert np.array_equal(np.asarray(blk.A.vals),
+                          np.asarray(ref.A.vals))
+    assert blk.tree.scen_names == ref.tree.scen_names
+    assert np.allclose(np.asarray(blk.tree.prob), 1.0 / idx.size)
+    assert ss.names(idx) == src.names(idx)
+    ss.close()
+
+
+def test_uc_shared_a_corpus_stays_shared_on_disk(tmp_path):
+    """A shared-A corpus (UC wind) round-trips with A still (1, M, N)
+    — the corpus never replicates the shared matrix per scenario."""
+    path = os.fspath(tmp_path / "uc_corpus")
+    uc.export_corpus(path, 12, shard_width=4, cfg={"H": 4, "n_units": 2})
+    src = uc.scenario_source(12, {"H": 4, "n_units": 2})
+    ss = ShardSource(path, depth=2)
+    idx = np.array([0, 5, 9])
+    _, blk = ss.block_with_indices(idx)
+    ref = src.block(idx)
+    A = np.asarray(blk.A)
+    assert A.shape[0] == 1 and A.shape == np.asarray(ref.A).shape
+    assert np.array_equal(A, np.asarray(ref.A))
+    assert np.array_equal(np.asarray(blk.row_lo),
+                          np.asarray(ref.row_lo))
+    assert ss.names(idx) == ["Scenario1", "Scenario6", "Scenario10"]
+    ss.close()
+
+
+def test_write_corpus_rejects_multistage(tmp_path):
+    from mpisppy_tpu.models import aircond
+    src = aircond.scenario_source(None, {"branching_factors": (3, 2)})
+    with pytest.raises(NotImplementedError, match="two-stage only"):
+        write_corpus(src, os.fspath(tmp_path / "ms"), 4)
+
+
+# ---- every read validated -------------------------------------------------
+
+def test_read_checked_rejects_flipped_payload_byte(farmer_corpus):
+    st = ShardStore(farmer_corpus, max_shard_retries=0,
+                    max_quarantined_frac=0.5)
+    p = st.shard_path(2)
+    data = bytearray(open(p, "rb").read())
+    data[-1] ^= 0xFF                       # payload region
+    atomic_write(p, bytes(data))
+    with pytest.raises(ShardQuarantinedError):
+        st.read_checked(2)
+    assert st.quarantined == {2}
+    # the direct decode names the CRC mismatch
+    with pytest.raises(ShardIntegrityError, match="CRC mismatch"):
+        _decode_shard(bytes(data))
+
+
+def test_decode_rejects_bad_magic_and_truncation(farmer_corpus):
+    st = ShardStore(farmer_corpus)
+    data = open(st.shard_path(0), "rb").read()
+    with pytest.raises(ShardIntegrityError, match="magic"):
+        _decode_shard(b"NOTMAGIC" + data[len(MAGIC):])
+    with pytest.raises(ShardIntegrityError, match="truncated|length"):
+        _decode_shard(data[:len(data) // 2])
+    # header expectations: wrong model ident / seed range
+    with pytest.raises(ShardIntegrityError, match="model ident"):
+        _decode_shard(data, expect_model="not_farmer")
+    with pytest.raises(ShardIntegrityError, match="seed range"):
+        _decode_shard(data, expect_range=(8, 16))
+
+
+def test_transient_io_error_recovers_without_quarantine(farmer_corpus):
+    st = ShardStore(farmer_corpus, max_shard_retries=2, backoff=0.001,
+                    backoff_cap=0.002, chaos={"io_error": 2})
+    blk = st.read_checked(0)
+    assert blk.num_scens == 8
+    assert st.read_retries == 2
+    assert st.quarantined == set()
+
+
+# ---- quarantine + deterministic substitution ------------------------------
+
+def test_quarantine_substitution_is_deterministic_and_healthy_only(
+        farmer_corpus):
+    ss = ShardSource(farmer_corpus, depth=3, max_shard_retries=1,
+                     backoff=0.001, max_quarantined_frac=0.5,
+                     chaos={"shard_corrupt": [5], "shard_missing": 6})
+    served, blk = ss.block_with_indices(np.arange(64))
+    assert sorted(ss.store.quarantined) == [5, 6]
+    assert ss.store.quarantined_frac == pytest.approx(0.25)
+    # substitutes never land in quarantined shards; block keeps shape
+    assert not np.isin(served // 8, [5, 6]).any()
+    assert served.size == 64 and blk.num_scens == 64
+    # pure function of (indices, quarantine set): a FRESH store with
+    # the same quarantine set replays the identical substitution
+    st2 = ShardStore(farmer_corpus, max_quarantined_frac=0.5)
+    st2.quarantined = {5, 6}
+    assert np.array_equal(served, st2.substitute_quarantined(
+        np.arange(64)))
+    # partial blocks keep the active-prefix discipline + distinctness
+    ss.close()
+    st3 = ShardStore(farmer_corpus, max_quarantined_frac=0.5)
+    st3.quarantined = {0}
+    out = st3.substitute_quarantined(np.array([0, 3, 17, 20, 41]))
+    assert out.max() <= 41 and np.unique(out).size == 5
+
+
+def test_quarantine_budget_hard_fails(farmer_corpus):
+    ss = ShardSource(farmer_corpus, depth=2, max_shard_retries=0,
+                     backoff=0.001, max_quarantined_frac=0.1,
+                     chaos={"shard_corrupt": [1, 2]})
+    with pytest.raises(QuarantinedCorpusError,
+                       match="max_quarantined_frac"):
+        ss.block_with_indices(np.arange(64))
+    ss.close()
+
+
+def test_retrying_source_propagates_corpus_hard_fail(farmer_corpus):
+    """RetryingSource must NOT retry (or mask as SourceBuildError) a
+    terminal QuarantinedCorpusError — retrying a dead corpus only
+    delays the hard fail."""
+    from mpisppy_tpu.streaming.source import RetryingSource
+    inner = ShardSource(farmer_corpus, depth=2, max_shard_retries=0,
+                        backoff=0.001, max_quarantined_frac=0.1,
+                        chaos={"shard_missing": [1, 2]})
+    src = RetryingSource(inner, retries=3, backoff=0.001)
+    with pytest.raises(QuarantinedCorpusError):
+        src.block_with_indices(np.arange(64))
+    assert src.retry_log == []           # zero retry attempts burned
+    inner.close()
+
+
+# ---- storage cursor -------------------------------------------------------
+
+def test_storage_cursor_roundtrips_quarantine_and_rng(farmer_corpus):
+    st = ShardStore(farmer_corpus, max_shard_retries=0, backoff=0.001,
+                    max_quarantined_frac=0.5,
+                    chaos={"shard_missing": [3]})
+    with pytest.raises(ShardQuarantinedError):
+        st.read_checked(3)
+    cur = st.state()
+    json.dumps(cur)                       # JSON-serializable contract
+    st2 = ShardStore(farmer_corpus, max_quarantined_frac=0.5)
+    st2.restore(cur)
+    assert st2.quarantined == {3}
+    assert st2.read_retries == st.read_retries
+    assert st2._retry_rng.getstate() == st._retry_rng.getstate()
+    idx = np.arange(40)
+    assert np.array_equal(st.substitute_quarantined(idx),
+                          st2.substitute_quarantined(idx))
+
+
+# ---- readahead ------------------------------------------------------------
+
+def test_readahead_hit_and_wait_accounting(farmer_corpus):
+    tel = telemetry.configure(True)
+    try:
+        st = ShardStore(farmer_corpus, telemetry=tel)
+        ra = ReadaheadCache(st, depth=4, telemetry=tel)
+        ra.schedule([0, 1])
+        a = ra.get(0)                     # hinted -> hit
+        b = ra.get(1)                     # hinted -> hit
+        c = ra.get(7)                     # demand -> miss
+        assert a.num_scens == b.num_scens == c.num_scens == 8
+        assert ra.hits == 2 and ra.misses == 1
+        assert ra.hit_rate == pytest.approx(2 / 3)
+        assert ra.wait_seconds >= 0.0
+        ctr = telemetry.storage_counters(tel.registry)
+        assert ctr["store_readahead_hits"] == 2
+        assert ctr["store_readahead_misses"] == 1
+        assert ctr["store_readahead_hit_rate"] == pytest.approx(2 / 3)
+        assert ctr["store_shards_read"] == 3
+        ra.close()
+    finally:
+        telemetry.reset()
+
+
+def test_readahead_relays_errors_and_drops_poisoned_entry(
+        farmer_corpus):
+    st = ShardStore(farmer_corpus, max_shard_retries=0, backoff=0.001,
+                    max_quarantined_frac=0.9,
+                    chaos={"shard_missing": [2]})
+    ra = ReadaheadCache(st, depth=2)
+    with pytest.raises(ShardQuarantinedError):
+        ra.get(2)
+    assert 2 not in ra._cache             # poisoned entry dropped
+    assert ra.get(0).num_scens == 8       # cache still serves
+    ra.close()
+    with pytest.raises(Exception):        # closed cache refuses demand
+        ra.get(1)
+
+
+def test_storage_counters_keys_stable_on_and_off():
+    keys = {"store_shards_read", "store_read_retries",
+            "store_shards_quarantined", "store_resampled_indices",
+            "store_readahead_hits", "store_readahead_misses",
+            "store_quarantined_frac", "store_readahead_hit_rate",
+            "store_read_wait_seconds"}
+    off = telemetry.storage_counters(
+        telemetry.Telemetry({"enabled": False}).registry)
+    assert set(off) == keys
+    assert all(v == 0 for v in off.values())
+    on = telemetry.storage_counters(
+        telemetry.Telemetry({"enabled": True}).registry)
+    assert set(on) == keys
+
+
+# ---- atomic_write (shared tmp-rename discipline) --------------------------
+
+def test_atomic_write_replaces_and_leaves_no_tmp(tmp_path):
+    p = os.fspath(tmp_path / "blob.bin")
+    atomic_write(p, b"first")
+    atomic_write(p, b"second")
+    assert open(p, "rb").read() == b"second"
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_atomic_writers_share_one_helper():
+    """The satellite de-dup: run/stream checkpoints, the W/xbar
+    snapshot, and the spoke solution publish all route through
+    resilience.checkpoint.atomic_write instead of carrying private
+    tmp-rename copies."""
+    import inspect
+
+    from mpisppy_tpu.cylinders import proc
+    from mpisppy_tpu.resilience import checkpoint
+    from mpisppy_tpu.utils import wxbarutils
+    assert "atomic_write" in inspect.getsource(checkpoint._atomic_savez)
+    assert "atomic_write" in inspect.getsource(
+        wxbarutils.write_W_and_xbar)
+    assert "atomic_write" in inspect.getsource(proc)
+
+
+# ---- chaos e2e + crash resume (acceptance) --------------------------------
+
+def _shard_opts(**kw):
+    o = {"PHIterLimit": 25, "defaultPHrho": 1.0, "solver_eps": 1e-6,
+         "stream_block_size": 8, "stream_check_every": 5,
+         "stream_seed": 0, "BM_h": 2.0, "BM_hprime": 0.4,
+         "BM_eps": 60000.0, "n0min": 64}
+    o.update(kw)
+    return o
+
+
+@pytest.mark.chaos
+def test_streaming_ph_chaos_e2e_certifies_with_gap_debit(tmp_path):
+    """The acceptance e2e: StreamingPH over a corpus under ALL FOUR
+    storage chaos modes reaches a certified stop with the same CI
+    verdict as the healthy run, the quarantined mass debited into the
+    reported gap (non-zero, CI strictly wider than healthy)."""
+    from mpisppy_tpu.streaming import StreamingPH
+
+    path = os.fspath(tmp_path / "corpus")
+    farmer.export_corpus(path, 64, shard_width=4)   # 16 shards
+
+    healthy = StreamingPH(_shard_opts(), ShardSource(path, depth=4),
+                          module=farmer)
+    healthy.stream_main(finalize=False)
+
+    chaotic = StreamingPH(
+        _shard_opts(),
+        ShardSource(path, depth=4, max_shard_retries=2, backoff=0.001,
+                    max_quarantined_frac=0.5,
+                    chaos={"io_delay": 0.002, "io_error": 2,
+                           "shard_corrupt": [10], "shard_missing": 13}),
+        module=farmer)
+    chaotic.stream_main(finalize=False)
+
+    hc, cc = healthy.certified, chaotic.certified
+    # CI-verdict parity: both certified under the same rule
+    assert hc is not None and cc is not None
+    assert hc["criterion"] == cc["criterion"]
+    # healthy run's estimate is bit-untouched by the debit machinery
+    assert hc["gap_debit"] == 0.0 and hc["quarantined_frac"] == 0.0
+    # lost mass debited into the reported gap: non-zero, CI wider
+    assert cc["gap_debit"] > 0.0
+    assert cc["quarantined_frac"] == pytest.approx(2 / 16)
+    assert cc["CI"][1] > hc["CI"][1]
+    assert cc["CI"][1] == pytest.approx(
+        hc["CI"][1] + cc["gap_debit"], rel=0.2)
+    st = chaotic.stream_stats()["storage"]
+    assert st["shards_quarantined"] == 2
+    assert st["read_retries"] >= 2        # io_error recovered, twice
+    assert st["resampled_indices"] > 0
+    assert st["readahead_hit_rate"] > 0.0
+
+
+@pytest.mark.chaos
+def test_crash_resume_bit_equal_through_storage_faults(tmp_path):
+    """A run that quarantines a shard, checkpoints every superstep,
+    and crashes mid-run resumes from the stream checkpoint's storage
+    cursor and bit-replays the uninterrupted degraded trajectory —
+    including the quarantine substitutions."""
+    from mpisppy_tpu.resilience.chaos import ChaosError
+    from mpisppy_tpu.streaming import StreamingPH
+
+    path = os.fspath(tmp_path / "corpus")
+    farmer.export_corpus(path, 64, shard_width=4)
+    ck = os.fspath(tmp_path / "stream_ck")
+
+    def mk(extra):
+        o = {"PHIterLimit": 6, "defaultPHrho": 1.0, "solver_eps": 1e-6,
+             "stream_block_size": 8, "stream_check_every": 100,
+             "stream_seed": 0, "n0min": 64}
+        o.update(extra)
+        src = ShardSource(path, depth=4, max_shard_retries=1,
+                          backoff=0.001, max_quarantined_frac=0.5,
+                          chaos={"shard_missing": 13})
+        return StreamingPH(o, src, module=None)
+
+    a = mk({})
+    a.stream_main(finalize=False)
+    assert a._shard_store().quarantined == {13}
+
+    b1 = mk({"run_checkpoint": ck, "checkpoint_every": 1,
+             "chaos": {"crash_at_iter": 3}})
+    with pytest.raises(ChaosError):
+        b1.stream_main(finalize=False)
+    b2 = mk({"resume_from": ck})
+    b2.stream_main(finalize=False)
+
+    assert b2._shard_store().quarantined == {13}
+    assert np.array_equal(a.W_host, b2.W_host)
+    assert np.array_equal(a.x_na_host, b2.x_na_host)
+    assert np.array_equal(a.xbar_host, b2.xbar_host)
+    assert np.array_equal(a.solved, b2.solved)
+    assert a.conv == b2.conv
+    assert int(a.state.it) == int(b2.state.it)
+    assert a.sampler.state()["rng_state"] == \
+        b2.sampler.state()["rng_state"]
+    assert np.array_equal(a._pending_indices, b2._pending_indices)
+
+
+# ---- ciutils debit unit ---------------------------------------------------
+
+def test_debit_quarantined_mass_scales_and_noops():
+    from mpisppy_tpu.confidence_intervals.ciutils import \
+        debit_quarantined_mass
+    est = {"G": 10.0, "zhats": -1000.0, "zstar": -900.0}
+    assert debit_quarantined_mass(dict(est), 0.0) == 0.0
+    e = dict(est)
+    d = debit_quarantined_mass(e, 0.1)
+    assert d == pytest.approx(100.0)      # 0.1 * |zhats| (the max)
+    assert e["G"] == pytest.approx(110.0)
+    assert e["quarantine_debit"] == d
+    # near-zero objectives floor the scale at 1.0
+    e2 = {"G": 0.0, "zhats": 1e-6, "zstar": 0.0}
+    assert debit_quarantined_mass(e2, 0.5) == pytest.approx(0.5)
+
+
+# ---- laziness guards ------------------------------------------------------
+
+def test_store_modules_fresh_interpreter_never_imports_jax():
+    """Runtime check for the AST guard (mirrors the mpmd pattern): a
+    fresh interpreter importing the store/readahead modules must not
+    pull jax."""
+    code = ("import mpisppy_tpu.streaming.store, "
+            "mpisppy_tpu.streaming.readahead, sys; "
+            "assert 'jax' not in sys.modules, 'store pulled jax'")
+    pkg_root = os.path.dirname(os.path.dirname(streaming_pkg.__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code],
+                       cwd=os.path.dirname(pkg_root),
+                       env=env, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize("mod", ["store.py", "readahead.py"])
+def test_store_modules_never_import_jax_eagerly(mod):
+    path = pathlib.Path(streaming_pkg.__file__).parent / mod
+    tree = ast.parse(path.read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            assert not any(a.name.split(".")[0] == "jax"
+                           for a in node.names), f"{mod}: import jax"
+        elif isinstance(node, ast.ImportFrom):
+            assert (node.module or "").split(".")[0] != "jax", \
+                f"{mod}: from jax import ..."
+            root = (node.module or "").rsplit(".", 1)[-1]
+            assert root not in ("ir", "streaming_ph"), \
+                f"{mod}: eager import of jax-backed module {root}"

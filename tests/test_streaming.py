@@ -317,7 +317,8 @@ def test_streaming_ph_rejects_w_bounds():
 def test_stream_counters_keys_stable_on_and_off():
     keys = {"stream_blocks_loaded", "stream_scenarios_streamed",
             "stream_sample_growth_events", "stream_supersteps",
-            "stream_source_retries", "stream_active_sample_size",
+            "stream_source_retries", "stream_source_giveups",
+            "stream_active_sample_size",
             "stream_prefetch_wait_seconds"}
     off = telemetry.stream_counters(
         telemetry.Telemetry({"enabled": False}).registry)
@@ -337,7 +338,8 @@ def test_stream_counters_keys_stable_on_and_off():
 
 
 @pytest.mark.parametrize("mod", ["__init__.py", "source.py",
-                                 "stream.py", "sampler.py"])
+                                 "stream.py", "sampler.py",
+                                 "store.py", "readahead.py"])
 def test_streaming_host_modules_never_import_jax_eagerly(mod):
     """AST guard (module-level statements only): the host-path modules
     must be importable without pulling in the accelerator runtime —
@@ -473,3 +475,91 @@ def test_streaming_ph_wires_source_retries_from_options():
     assert len(sph.source.retry_log) >= 1   # the template build retried
     sph.stream_main(finalize=False)
     assert np.isfinite(sph.conv)
+
+
+# ---- source error paths (PR 14 satellites) --------------------------------
+
+def test_source_build_error_carries_retry_state_and_giveups_counter():
+    """Terminal exhaustion surfaces THIS call's attempt/backoff ladder
+    on the exception (not just the wrapper's cumulative log) and bumps
+    stream.source_giveups — retries alone would leave give-ups
+    invisible to telemetry."""
+    from mpisppy_tpu.resilience.chaos import ChaosInjector
+    from mpisppy_tpu.streaming.source import (RetryingSource,
+                                              SourceBuildError)
+
+    tel = telemetry.configure(True)
+    try:
+        src = RetryingSource(
+            BatchSource(farmer.build_batch(8)), retries=2,
+            backoff=0.001, backoff_cap=0.002,
+            chaos=ChaosInjector({"block_build_fail": 99}))
+        with pytest.raises(SourceBuildError) as ei:
+            src.block(np.arange(2))
+        e = ei.value
+        assert len(e.retry_state) == 2
+        assert [r["attempt"] for r in e.retry_state] == [1, 2]
+        assert all(set(r) == {"attempt", "error", "delay"}
+                   for r in e.retry_state)
+        # a SECOND failing call's exception carries only ITS ladder
+        with pytest.raises(SourceBuildError) as ei2:
+            src.block(np.arange(2))
+        assert len(ei2.value.retry_state) == 2
+        assert len(src.retry_log) == 4       # cumulative wrapper log
+        ctr = telemetry.stream_counters(tel.registry)
+        assert ctr["stream_source_giveups"] == 2
+        assert ctr["stream_source_retries"] == 4
+    finally:
+        telemetry.reset()
+
+
+def test_generator_builder_raising_mid_block_surfaces_on_next_block():
+    """A builder that dies partway through a block (not at validation
+    time) propagates through the stream worker and re-raises on
+    next_block() — the stream never emits a half-built block."""
+    from mpisppy_tpu.streaming.source import GeneratorSource
+
+    calls = {"n": 0}
+
+    def flaky(idx):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("store died mid-block")
+        return farmer.scenario_block(idx)
+
+    src = GeneratorSource("flaky", 16, flaky)
+    st = ScenarioStream(src)
+    st.prefetch(np.arange(4))
+    st.prefetch(np.arange(4, 8))
+    i0, b0 = st.next_block()             # first build succeeds
+    assert b0.num_scens == 4
+    with pytest.raises(RuntimeError, match="mid-block"):
+        st.next_block()
+    st.close()
+
+
+def test_batch_source_rejects_empty_index_set():
+    src = BatchSource(farmer.build_batch(8))
+    with pytest.raises(ValueError, match="empty scenario block"):
+        src.block(np.array([], dtype=np.int64))
+    with pytest.raises(IndexError):
+        src.block(np.array([8]))
+
+
+def test_gather_block_uniform_fallback_on_all_zero_prob_block():
+    """Gathering a block whose scenario probabilities sum to zero
+    (degenerate corner of prob renormalization) falls back to
+    block-uniform instead of dividing by zero."""
+    import dataclasses
+
+    from mpisppy_tpu.streaming.source import gather_block
+
+    batch = farmer.build_batch(8)
+    prob = np.asarray(batch.tree.prob, np.float64).copy()
+    prob[:3] = 0.0
+    batch = dataclasses.replace(
+        batch, tree=dataclasses.replace(batch.tree, prob=prob))
+    blk = gather_block(batch, np.array([0, 1, 2]))   # all-zero subset
+    p = np.asarray(blk.tree.prob)
+    assert np.allclose(p, 1.0 / 3.0)
+    assert abs(p.sum() - 1.0) < 1e-12
