@@ -831,19 +831,24 @@ def worker_farmer_shard():
 def worker_wheel_mpmd():
     """BENCH_MODEL=wheel_mpmd: the device-resident MPMD wheel
     (mpisppy_tpu/mpmd/) — hub + Lagrangian + xhat cylinders on
-    DISJOINT mesh slices exchanging bound/xhat/W vectors through
-    device mailboxes instead of the host seqlock.  On a CPU landing
-    the fleet is faked to BENCH_MPMD_DEVICES (default 8) virtual
-    devices; on a multi-chip accelerator the real device list is
-    sliced.  `value` is the wall-clock to the hub's certified gap
-    termination (rel_gap), -1 if the iteration budget ran out first.
-    The JSON carries the MPMD-specific fields: n_slices,
-    exchange_latency_seconds (total device-mailbox transfer time),
-    hub_overlap_fraction (share of hub wall-clock covered by
-    concurrent spoke work on other slices), per-slice phase_seconds,
-    and the wheel.* telemetry counters.  A box with too few devices
-    for even 1-device slices degrades to a single-slice seqlock wheel
-    and says so in `note`."""
+    DISJOINT mesh slices.  The measured run uses the "collective"
+    exchange backend (one fused all-gather + broadcast per staged
+    superstep, mpmd/collective.py); a second A/B run with the
+    per-pair "device" mailbox backend quantifies the fusion win.  On
+    a CPU landing the fleet is faked to BENCH_MPMD_DEVICES (default
+    8) virtual devices; on a multi-chip accelerator the real device
+    list is sliced.  `value` is the wall-clock to the hub's certified
+    gap termination (rel_gap) on the collective run, -1 if the
+    iteration budget ran out first.  The JSON carries the
+    MPMD-specific fields: n_slices, exchange_backend,
+    exchange_latency_seconds / exchange_latency_seconds_device (the
+    A/B pair, total exchange transfer time per backend),
+    exchange_bytes_per_superstep, hub_overlap_fraction (share of hub
+    wall-clock covered by concurrent spoke work on other slices),
+    per-slice phase_seconds, bound-parity fields for the two
+    backends, and the wheel.* telemetry counters.  A box with too few
+    devices for even 1-device slices degrades to a single-slice
+    seqlock wheel (no A/B) and says so in `note`."""
     ndev = int(os.environ.get("BENCH_MPMD_DEVICES", 8))
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
@@ -873,55 +878,89 @@ def worker_wheel_mpmd():
     S = int(os.environ.get("BENCH_SCENS", 100))
     iters = int(os.environ.get("BENCH_ITERS", 40))
     rel_gap = float(os.environ.get("BENCH_REL_GAP", 1e-4))
-    telemetry.configure(True)
     names = [f"scen{i}" for i in range(S)]
-    opts = {"defaultPHrho": 1.0, "PHIterLimit": iters,
-            "convthresh": 0.0, "pdhg_eps": 1e-7,
-            "pdhg_max_iters": 30000, "telemetry": True}
-    hub_dict = {
-        "hub_class": PHHub,
-        "hub_kwargs": {"options": {"rel_gap": rel_gap, "abs_gap": 1.0}},
-        "opt_class": PH,
-        "opt_kwargs": {"options": opts, "all_scenario_names": names,
-                       "batch": farmer.build_batch(S)},
-    }
-    spoke_dicts = [
-        {"spoke_class": LagrangianOuterBound,
-         "spoke_kwargs": {"options": {}},
-         "opt_class": PH,
-         "opt_kwargs": {"options": dict(opts),
-                        "all_scenario_names": names}},
-        {"spoke_class": XhatShuffleInnerBound,
-         "spoke_kwargs": {"options": {}},
-         "opt_class": Xhat_Eval,
-         "opt_kwargs": {"options": dict(opts),
-                        "all_scenario_names": names}},
-    ]
+    base_opts = {"defaultPHrho": 1.0, "PHIterLimit": iters,
+                 "convthresh": 0.0, "pdhg_eps": 1e-7,
+                 "pdhg_max_iters": 30000, "telemetry": True}
+    batch = farmer.build_batch(S)
+
+    def run(backend):
+        """One full wheel spin with a forced exchange backend, fresh
+        telemetry; returns (spinner, wall_seconds, wheel_counters)."""
+        telemetry.reset()
+        telemetry.configure(True)
+        hub_opts = {"rel_gap": rel_gap, "abs_gap": 1.0}
+        if backend is not None:
+            hub_opts["window_backend"] = backend
+        hub_dict = {
+            "hub_class": PHHub,
+            "hub_kwargs": {"options": hub_opts},
+            "opt_class": PH,
+            "opt_kwargs": {"options": dict(base_opts),
+                           "all_scenario_names": names, "batch": batch},
+        }
+        spoke_dicts = [
+            {"spoke_class": LagrangianOuterBound,
+             "spoke_kwargs": {"options": {}},
+             "opt_class": PH,
+             "opt_kwargs": {"options": dict(base_opts),
+                            "all_scenario_names": names}},
+            {"spoke_class": XhatShuffleInnerBound,
+             "spoke_kwargs": {"options": {}},
+             "opt_class": Xhat_Eval,
+             "opt_kwargs": {"options": dict(base_opts),
+                            "all_scenario_names": names}},
+        ]
+        if len(jax.devices()) >= len(spoke_dicts) + 1:
+            ws = MPMDWheel(hub_dict, spoke_dicts)
+        else:
+            ws = WheelSpinner(hub_dict, spoke_dicts, mode="threads",
+                              exchange_backend="seqlock")
+        t0 = time.time()
+        ws.spin()
+        return ws, time.time() - t0, telemetry.wheel_counters()
+
     note = None
-    n_devices = len(jax.devices())
-    if n_devices >= len(spoke_dicts) + 1:
-        ws = MPMDWheel(hub_dict, spoke_dicts)
-    else:
-        note = (f"{n_devices} device(s): too few for disjoint slices; "
-                "single-slice seqlock wheel")
-        ws = WheelSpinner(hub_dict, spoke_dicts, mode="threads",
-                          exchange_backend="seqlock")
-    t0 = time.time()
-    ws.spin()
-    wall = time.time() - t0
+    mpmd_capable = len(jax.devices()) >= 3
+    if not mpmd_capable:
+        note = (f"{len(jax.devices())} device(s): too few for disjoint "
+                "slices; single-slice seqlock wheel, no A/B")
+    # measured run: the fused collective fabric (auto-selected on an
+    # MPMD fleet; explicit so a future default change can't skew the
+    # metric); baseline run: per-pair device mailboxes
+    ws, wall, counters = run("collective" if mpmd_capable else None)
+    dev_latency = None
+    ab = {}
+    if mpmd_capable:
+        ws_d, wall_d, counters_d = run("device")
+        dev_latency = counters_d["wheel_exchange_latency_seconds"]
+        ab = {
+            "exchange_latency_seconds_device": round(dev_latency, 6),
+            "wall_seconds_device": round(wall_d, 3),
+            "device_best_outer": round(float(ws_d.BestOuterBound), 3),
+            "device_best_inner": round(float(ws_d.BestInnerBound), 3),
+        }
     ob = float(ws.BestOuterBound)
     ib = float(ws.BestInnerBound)
     gap = abs(ib - ob) / max(1.0, abs(ib))
     certified = gap <= rel_gap
-    counters = telemetry.wheel_counters()
     plan = getattr(ws, "plan", None)
+    coll_latency = counters["wheel_exchange_latency_seconds"]
+    n_supersteps = counters.get("wheel_collective_exchanges", 0)
     out = {
         "metric": f"farmer{S}_wheel_mpmd_seconds_to_certified_gap",
         "value": round(wall, 3) if certified else -1,
         "unit": "s", "vs_baseline": 0,
         "n_slices": plan.n_slices if plan is not None else 1,
-        "exchange_latency_seconds": round(
-            counters["wheel_exchange_latency_seconds"], 6),
+        "exchange_backend": getattr(ws, "exchange_backend_used", None)
+        or "seqlock",
+        "exchange_latency_seconds": round(coll_latency, 6),
+        "exchange_bytes_per_superstep": round(
+            counters["wheel_exchange_bytes"] / n_supersteps, 1)
+        if n_supersteps else 0,
+        **ab,
+        "exchange_latency_ratio": round(coll_latency / dev_latency, 4)
+        if dev_latency else None,
         "hub_overlap_fraction": round(
             getattr(ws, "hub_overlap_fraction", 0.0), 4),
         "phase_seconds": {

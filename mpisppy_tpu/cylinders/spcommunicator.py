@@ -132,7 +132,24 @@ class WindowPair:
     `path_prefix`.  Any other name resolves through the registered
     backend factories (register_window_backend) with `backend_kwargs`
     passed through opaquely — the "device" backend registered by
-    mpisppy_tpu.mpmd takes per-slice device placements this way.
+    mpisppy_tpu.mpmd takes per-slice device placements this way, and
+    its "collective" backend takes the wheel's shared fabric object.
+
+    The registered on-device backends (doc/src/mpmd.md has the full
+    matrix):
+
+      * "device"     — one device-resident mailbox per direction
+                       (mpmd/exchange.py): each write is its own
+                       device_put + sync;
+      * "collective" — every pair is one lane row of two shared
+                       (K, header+V_pad) slabs (mpmd/collective.py):
+                       writes stage host-side, and the first read of a
+                       staged generation moves the WHOLE direction
+                       with one fused all-gather / broadcast.  The
+                       seqlock metadata (write_id, CRC32, payload
+                       length) rides in the slab's three header
+                       columns, so read_checked validates the same
+                       contract on both.
     """
 
     def __init__(self, hub_length: int, spoke_length: int,

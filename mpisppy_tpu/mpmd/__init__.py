@@ -1,12 +1,17 @@
 """mpmd — the wheel as a multi-chip MPMD program (doc/src/mpmd.md).
 
-Three pieces:
+The pieces:
 
   * `SlicePlan` (slice_plan.py) — partition the global device list
     into disjoint per-cylinder submeshes (hub large, spokes small);
   * `DeviceWindow` / `device_window_pair` (exchange.py) — versioned
     device-resident mailboxes with the seqlock's write_id contract,
     registered below as the "device" window backend;
+  * `CollectiveFabric` / `collective_window_pair` (collective.py) —
+    the fused exchange: every pair is one lane row of two shared
+    slabs, moved with ONE jitted all-gather (spokes->hub) plus one
+    broadcast (hub->spokes) per superstep, registered below as the
+    "collective" backend;
   * `MPMDWheel` + `SliceSupervisor` (wheel.py) — one controller thread
     per slice, spoke supersteps overlapping hub supersteps, per-slice
     supervision and telemetry;
@@ -14,27 +19,35 @@ Three pieces:
     dies: the supervisor live-applies them, returning a pruned spoke's
     devices to the hub (elastic recovery, doc/src/mpmd.md).
 
-Importing this package is what makes WindowPair(backend="device")
-resolvable — the WheelSpinner seam imports it lazily when it selects
-the device exchange; cylinders/ itself never imports mpmd (AST-guarded
-by tests/test_mpmd_wheel.py).  jax stays lazy throughout: importing
-mpisppy_tpu.mpmd does not initialize the accelerator runtime.
+Importing this package is what makes WindowPair(backend="device") and
+WindowPair(backend="collective") resolvable — the WheelSpinner seam
+imports it lazily when it selects an on-device exchange; cylinders/
+itself never imports mpmd (AST-guarded by tests/test_mpmd_wheel.py).
+jax stays lazy throughout: importing mpisppy_tpu.mpmd does not
+initialize the accelerator runtime.
 """
 
 from ..cylinders.spcommunicator import register_window_backend
+from .collective import (CollectiveFabric, CollectiveWindow,
+                         collective_window_pair)
 from .exchange import DeviceWindow, device_window_pair
 from .reslice import ReslicePlanner
-from .slice_plan import CylinderSlice, SlicePlan
+from .slice_plan import CylinderSlice, SlicePlan, slab_width
 from .wheel import MPMDWheel, SliceSupervisor
 
 register_window_backend("device", device_window_pair)
+register_window_backend("collective", collective_window_pair)
 
 __all__ = [
+    "CollectiveFabric",
+    "CollectiveWindow",
     "CylinderSlice",
     "DeviceWindow",
     "MPMDWheel",
     "ReslicePlanner",
     "SlicePlan",
     "SliceSupervisor",
+    "collective_window_pair",
     "device_window_pair",
+    "slab_width",
 ]

@@ -23,6 +23,17 @@ import math
 from dataclasses import dataclass
 
 
+def slab_width(lengths, multiple=1):
+    """Padded payload width of one collective-exchange slab direction
+    (mpmd/collective.py): the max over the lane vector lengths, rounded
+    up to `multiple` — the plan's pad_multiple() — so a slab regrown
+    after a reslice stays aligned with the padding quantum the batch
+    itself was padded to."""
+    w = max((int(n) for n in lengths), default=1)
+    q = max(int(multiple), 1)
+    return max(1, ((w + q - 1) // q) * q)
+
+
 @dataclass(frozen=True)
 class CylinderSlice:
     """One cylinder's share of the fleet: `index` 0 is the hub."""

@@ -284,12 +284,36 @@ class SliceSupervisor:
         readers only consume their own leading rows) and re-posted
         under the OLD write_id: a fresh window would restart ids at 1,
         which is < the spoke's last_hub_id and would freeze its
-        freshness check forever."""
+        freshness check forever.
+
+        Collective pairs regrow as ONE fabric-level slab resize
+        (CollectiveFabric.regrow_to_spoke re-stages every lane the same
+        way); if that fails the surviving pairs fall back cleanly onto
+        device mailboxes at the new width, re-posted under their old
+        ids, and the wheel finishes on the per-pair backend."""
+        from .collective import CollectiveWindow
+
+        regrown = set()
         for j, sp in enumerate(self.spokes):
             if getattr(sp, "_failed", False) or sp.pair is None:
                 continue
             old = sp.pair.to_spoke
             if old.length == new_len:
+                continue
+            if isinstance(old, CollectiveWindow):
+                fab = old.fabric
+                if id(fab) in regrown:
+                    continue
+                regrown.add(id(fab))
+                try:
+                    fab.regrow_to_spoke(new_len)
+                    self._tel.event("wheel.collective_regrow",
+                                    width=new_len)
+                except Exception as e:
+                    global_toc(f"WARNING: collective slab regrow "
+                               f"failed ({e}); falling back to device "
+                               "mailboxes")
+                    self._fallback_to_device_mailboxes(fab, new_len)
                 continue
             if hasattr(old, "device"):       # DeviceWindow placement
                 new_win = type(old)(new_len, device=old.device,
@@ -307,6 +331,41 @@ class SliceSupervisor:
             # covers both endpoints; readers tolerate either window
             # during the handoff (old stays readable until collected)
             sp.pair.to_spoke = new_win
+
+    def _fallback_to_device_mailboxes(self, fabric, new_len):
+        """Swap every surviving pair of `fabric` onto DeviceWindow
+        mailboxes: both directions, last staged payloads re-posted
+        under their old write_ids (straight from the staging slab —
+        no device work through the possibly-broken fused program)."""
+        from .collective import CollectiveWindow
+        from .exchange import DeviceWindow
+
+        hub_dev = self.plan.hub.devices[0]
+        for j, sp in enumerate(self.spokes):
+            pair = sp.pair
+            if getattr(sp, "_failed", False) or pair is None \
+                    or not isinstance(pair.to_spoke, CollectiveWindow) \
+                    or pair.to_spoke.fabric is not fabric:
+                continue
+            spoke_dev = (self._slice_of[j].devices[0]
+                         if j in self._slice_of else None)
+            for dirn, length, dev in (
+                    ("to_spoke", new_len, spoke_dev),
+                    ("to_hub", pair.to_hub.length, hub_dev)):
+                old = getattr(pair, dirn)
+                new_win = DeviceWindow(length, device=dev, tag=old.tag)
+                data, wid = fabric.staged_payload(old)
+                if wid not in (0, old.KILL):
+                    payload = np.zeros(length)
+                    n = min(length, data.shape[0])
+                    payload[:n] = data[:n]
+                    new_win.write(payload, write_id=wid)
+                elif wid == old.KILL:
+                    new_win.send_kill()
+                old.close()
+                setattr(pair, dirn, new_win)
+        self._tel.event("wheel.collective_fallback", width=new_len)
+        self._tel.counter("wheel.collective_fallbacks").inc()
 
     def on_sync_end(self):
         """Ensemble checkpoint hook: END of hub sync is the wheel's
@@ -398,6 +457,7 @@ class MPMDWheel(WheelSpinner):
         self.spoke_devices = spoke_devices
         self.lockstep = lockstep
         self.supervisor = None
+        self.fabric = None
         self.hub_main_seconds = 0.0
         self.hub_overlap_fraction = 0.0
         self.slice_phase_seconds = {}
@@ -445,14 +505,43 @@ class MPMDWheel(WheelSpinner):
             spokes.append(spoke)
 
         hub_options = dict(hd.get("hub_kwargs", {}).get("options") or {})
-        hub_options.setdefault("window_backend", "device")
-        # each pair's mailboxes pin to the receiving slice's first
-        # device (device_window_pair)
-        hub_options["window_backend_kwargs"] = {
-            j: {"spoke_device": plan.spokes[j].devices[0],
-                "hub_device": plan.hub.devices[0],
-                "tag": f"pair{j}"}
-            for j in range(len(spokes))}
+        backend = hub_options.get("window_backend")
+        if backend is None:
+            # ISSUE/ROADMAP auto-selection: the fused collective fabric
+            # whenever the hub mesh spans >1 device; a 1-device hub
+            # (minimal 3-device fleet) keeps the per-pair mailboxes
+            backend = ("collective" if spokes and plan.hub.n_devices > 1
+                       else "device")
+        if backend == "collective" \
+                and "window_backend_kwargs" not in hub_options:
+            try:
+                from .collective import CollectiveFabric
+                # one lane row per spoke, on that spoke slice's first
+                # device: the gather input rows land on the slices
+                # that stage them, so the all-gather is the real
+                # cross-slice hop
+                self.fabric = CollectiveFabric(
+                    devices=[s.devices[0] for s in plan.spokes],
+                    pad_multiple=plan.pad_multiple(), tag="mpmd")
+                hub_options["window_backend_kwargs"] = {
+                    j: {"fabric": self.fabric, "tag": f"pair{j}"}
+                    for j in range(len(spokes))}
+            except Exception as e:
+                global_toc(f"MPMDWheel: collective fabric unavailable "
+                           f"({e}); using device mailboxes")
+                backend = "device"
+        if backend == "device" \
+                and "window_backend_kwargs" not in hub_options:
+            # each pair's mailboxes pin to the receiving slice's first
+            # device (device_window_pair)
+            hub_options["window_backend_kwargs"] = {
+                j: {"spoke_device": plan.spokes[j].devices[0],
+                    "hub_device": plan.hub.devices[0],
+                    "tag": f"pair{j}"}
+                for j in range(len(spokes))}
+        hub_options["window_backend"] = backend
+        self.exchange_backend_used = backend
+        global_toc(f"MPMDWheel: {backend!r} exchange backend")
         hub = hd["hub_class"](hub_opt, spokes, options=hub_options)
         hub.setup_hub()
         self._restore_hub_bounds(hub)

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import os
 
+import numpy as np
+
 import jax
 
 from .mesh import ScenarioMesh
@@ -56,6 +58,36 @@ def global_mesh(axis_name="scen"):
     """ScenarioMesh over the GLOBAL device list (call after
     init_multihost)."""
     return ScenarioMesh(devices=jax.devices(), axis_name=axis_name)
+
+
+class LaneTransport:
+    """Host->fabric placement seam of the collective exchange
+    (mpmd/collective.py): the two ways a staged slab reaches the lane
+    mesh.  Single-process this is plain `device_put` through
+    ScenarioMesh._put; once a wheel spans hosts, the SAME two calls go
+    through `jax.make_array_from_callback` — each process materializes
+    only its addressable lane rows and the fused all-gather's
+    collectives cross DCN — so a later multihost PR plugs in here
+    without touching the fabric above."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def sharded(self, slab):
+        """Place a (K, V) slab lane-sharded over the `cyl` axis: each
+        lane's rows land on the device (process) that owns that lane —
+        the input placement of the fused all-gather."""
+        return self.mesh._put(np.asarray(slab), self.mesh.lane_sharding())
+
+    def replicated(self, slab):
+        """Place a (K, V) slab fully replicated over the lane mesh —
+        the hub->spokes broadcast is exactly this one placement."""
+        return self.mesh._put(np.asarray(slab), self.mesh.replicated())
+
+
+def lane_transport(mesh):
+    """The LaneTransport for a fabric's 2-D lane ScenarioMesh."""
+    return LaneTransport(mesh)
 
 
 def process_index():

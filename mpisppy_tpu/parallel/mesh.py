@@ -171,6 +171,61 @@ class ScenarioMesh:
     def replicate(self, arr):
         return self._put(np.asarray(arr), self.replicated())
 
+    def lane_sharding(self):
+        """Sharding for (K, ...) lane-leading slabs on a 2-D cylinder
+        mesh: rows split over the `cyl` axis, one block of lanes per
+        cylinder row — the placement of the collective exchange
+        fabric's staged slab (mpmd/collective.py)."""
+        if not self.n_cyl:
+            raise ValueError(
+                "lane_sharding needs a 2-D cylinder mesh (n_cyl)")
+        return NamedSharding(self.mesh, P(self.cyl_axis))
+
+    def fused_cyl_all_gather(self, on_trace=None, donate=True):
+        """ONE jitted collective for the whole exchange: shard_map of
+        `jax.lax.all_gather` over the `cyl` axis, turning a
+        lane-sharded (K, V) slab into a fully replicated copy on every
+        lane device — the spokes->hub direction of the MPMD wheel's
+        collective fabric.  `donate=True` donates the staged input
+        buffer to the program (the slab never detours through a fresh
+        host allocation); `on_trace` fires at trace time only, the hook
+        behind the single-compile-per-geometry assertion.
+        check_rep=False: with out_specs=P() the all-gather's output IS
+        replicated over `cyl`, but shard_map's replication checker
+        cannot infer that and would reject the specs."""
+        from jax.experimental.shard_map import shard_map
+
+        if not self.n_cyl:
+            raise ValueError(
+                "fused_cyl_all_gather needs a 2-D cylinder mesh (n_cyl)")
+        axis = self.cyl_axis
+
+        def gather(x):
+            if on_trace is not None:
+                on_trace()
+            return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+
+        fn = shard_map(gather, mesh=self.mesh, in_specs=P(axis),
+                       out_specs=P(), check_rep=False)
+        jfn = jax.jit(fn, in_shardings=self.lane_sharding(),
+                      out_shardings=self.replicated(),
+                      donate_argnums=(0,) if donate else ())
+        if not donate:
+            return jfn
+
+        def call(x):
+            # a replicated output is larger than any per-device input
+            # shard, so XLA may find nothing to alias the donation to
+            # (it still frees the staged buffer); silence that per-call
+            # compile-time warning, it is expected here
+            import warnings
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+                return jfn(x)
+
+        return call
+
 
 def local_mesh():
     """Mesh over whatever devices are visible (1 TPU chip, or N forced

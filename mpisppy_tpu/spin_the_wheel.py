@@ -36,11 +36,15 @@ class WheelSpinner:
         self.hub_dict = hub_dict
         self.list_of_spoke_dict = list(list_of_spoke_dict)
         self.mode = mode
-        # exchange seam: None/"auto" picks by device count ("device"
-        # mailboxes on a multi-device fleet, host seqlock on one
-        # device); "seqlock"/"native"/"device" force a backend.  An
-        # explicit window_backend in the hub options always wins.
+        # exchange seam: None/"auto" picks by device count and mode
+        # (the fused "collective" fabric on a multi-device fleet,
+        # "device" mailboxes in threads mode, host seqlock on one
+        # device); "seqlock"/"native"/"device"/"collective" force a
+        # backend.  An explicit window_backend in the hub options
+        # always wins.
         self.exchange_backend = exchange_backend
+        self.exchange_backend_used = None
+        self.fabric = None
         self.spcomm = None
         self._ran = False
         # multiproc mode: keep the window/log tempdir for debugging
@@ -59,28 +63,59 @@ class WheelSpinner:
 
     def _select_backend(self, hub_opt):
         """Resolve the exchange backend for the in-process modes.
-        "auto" (the default) selects the device-resident mailboxes
-        (mpmd/exchange.py) whenever the hub's mesh spans more than one
-        device, and the host seqlock on a single device — so existing
-        single-device runs are bit-identical and multi-device runs keep
-        the exchange on-device.  Multiproc mode never lands here (it is
-        always the native mmap seqlock: device buffers cannot cross a
-        process boundary)."""
+        "auto" (the default) keeps the exchange on-device whenever the
+        hub's mesh spans more than one device — the fused collective
+        fabric (mpmd/collective.py) for the single-threaded interleaved
+        schedule, the per-pair device mailboxes (mpmd/exchange.py) in
+        `threads` mode (spoke threads would interleave fused
+        collectives with the hub's own programs on the shared mesh) —
+        and the host seqlock on a single device, so existing
+        single-device runs are bit-identical.  Multiproc mode never
+        lands here (it is always the native mmap seqlock: device
+        buffers cannot cross a process boundary)."""
         req = self.exchange_backend or "auto"
         if req in ("seqlock", "python"):
             return "python"
         if req == "native":
             return "native"
         n = getattr(getattr(hub_opt, "mesh", None), "size", 1)
-        if req == "device" or (req == "auto" and n > 1):
+        if req in ("device", "collective") or (req == "auto" and n > 1):
             try:
                 from . import mpmd  # noqa: F401 — registers "device"
-                return "device"
+                #                           and "collective"
             except Exception as e:  # pragma: no cover - degraded env
                 global_toc(f"WheelSpinner: device exchange unavailable "
                            f"({e}); using the host seqlock")
                 return "python"
+            if req == "auto":
+                return ("device" if self.mode == "threads"
+                        else "collective")
+            return req
         return "python"
+
+    def _collective_kwargs(self, hub_opt, n_spokes):
+        """Shared CollectiveFabric + per-pair backend_kwargs for the
+        "collective" backend: one lane row per spoke, lane devices
+        drawn from the hub mesh (the shared-mesh modes timeshare
+        devices; MPMDWheel overrides this with per-slice placements).
+        None means the fabric cannot be built here — the caller drops
+        to the device-mailbox backend."""
+        if n_spokes == 0:
+            return None
+        try:
+            from .mpmd.collective import CollectiveFabric
+            devs = list(getattr(getattr(hub_opt, "mesh", None),
+                                "devices", None) or [])
+            if not devs:
+                return None
+            self.fabric = CollectiveFabric(
+                devices=devs[:min(len(devs), n_spokes)])
+            return {j: {"fabric": self.fabric, "tag": f"pair{j}"}
+                    for j in range(n_spokes)}
+        except Exception as e:  # pragma: no cover - degraded env
+            global_toc(f"WheelSpinner: collective fabric unavailable "
+                       f"({e}); using device mailboxes")
+            return None
 
     def _restore_hub_bounds(self, hub):
         from .resilience.checkpoint import checkpoint_exists, restore_hub
@@ -139,8 +174,17 @@ class WheelSpinner:
             spokes.append(spoke)
 
         hub_options = dict(hd.get("hub_kwargs", {}).get("options") or {})
-        hub_options.setdefault(
-            "window_backend", self._select_backend(hub_opt))
+        if "window_backend" not in hub_options:
+            backend = self._select_backend(hub_opt)
+            if backend == "collective" \
+                    and "window_backend_kwargs" not in hub_options:
+                bkw = self._collective_kwargs(hub_opt, len(spokes))
+                if bkw is None:
+                    backend = "device"
+                else:
+                    hub_options["window_backend_kwargs"] = bkw
+            hub_options["window_backend"] = backend
+        self.exchange_backend_used = hub_options["window_backend"]
         hub = hd["hub_class"](hub_opt, spokes, options=hub_options)
         hub.setup_hub()
         self._restore_hub_bounds(hub)

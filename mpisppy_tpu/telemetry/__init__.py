@@ -283,9 +283,10 @@ def wheel_counters(registry=None):
     per-slice bound-progression gauges keyed by trace track."""
     reg = registry if registry is not None else get().registry
     names = ("wheel.exchange_writes", "wheel.exchange_bytes",
-             "wheel.stale_reads", "wheel.slice_restarts",
-             "wheel.slices_failed", "wheel.reslice_events",
-             "wheel.corrupt_reads", "wheel.devices_reclaimed")
+             "wheel.collective_exchanges", "wheel.stale_reads",
+             "wheel.slice_restarts", "wheel.slices_failed",
+             "wheel.reslice_events", "wheel.corrupt_reads",
+             "wheel.devices_reclaimed")
     vals = ({k: c.value for k, c in reg._counters.items()}
             if reg.enabled else {})
     out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}

@@ -344,9 +344,13 @@ class TestBackendSeam:
                 self.mesh = type("M", (), {"size": n})()
 
         ws = WheelSpinner(hub_dict, spoke_dicts)
-        assert ws._select_backend(FakeOpt(8)) == "device"   # auto, fleet
+        # auto on a fleet: the fused collective fabric (interleaved)
+        assert ws._select_backend(FakeOpt(8)) == "collective"
         assert ws.exchange_backend is None
         assert ws._select_backend(FakeOpt(1)) == "python"   # auto, solo
+        # threads mode keeps per-pair mailboxes under auto
+        ws = WheelSpinner(hub_dict, spoke_dicts, mode="threads")
+        assert ws._select_backend(FakeOpt(8)) == "device"
         ws = WheelSpinner(hub_dict, spoke_dicts,
                           exchange_backend="seqlock")
         assert ws._select_backend(FakeOpt(8)) == "python"   # forced host
@@ -356,6 +360,9 @@ class TestBackendSeam:
         ws = WheelSpinner(hub_dict, spoke_dicts,
                           exchange_backend="device")
         assert ws._select_backend(FakeOpt(1)) == "device"   # forced device
+        ws = WheelSpinner(hub_dict, spoke_dicts,
+                          exchange_backend="collective")
+        assert ws._select_backend(FakeOpt(1)) == "collective"  # forced
 
 
 class RecordingHub(PHHub):
@@ -537,7 +544,8 @@ class TestImportLayering:
                         assert a.name != "mpmd", \
                             f"cylinders/{fn} imports mpmd"
 
-    @pytest.mark.parametrize("fn", ["__init__.py", "exchange.py",
+    @pytest.mark.parametrize("fn", ["__init__.py", "collective.py",
+                                    "exchange.py",
                                     "reslice.py", "slice_plan.py",
                                     "wheel.py"])
     def test_mpmd_keeps_jax_lazy(self, fn):
