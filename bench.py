@@ -594,6 +594,139 @@ def worker_serve():
     print(json.dumps(out))
 
 
+def worker_serve_net():
+    """BENCH_MODEL=serve_net: the network front door end to end
+    (mpisppy_tpu/serve/net/) — an A/B cold-start measurement of the
+    disk-persisted AOT executables, then an open socket load through a
+    real Gateway with concurrent wire clients.
+
+    Phase 1 (AOT A/B): a fresh CompileCache traces + persists the
+    batched superstep (`cold_start_seconds_trace`), then a second
+    fresh cache — a process-restart stand-in — rebuilds the same
+    bucket from the on-disk artifact (`cold_start_seconds`,
+    `aot_cache_hits`).  Phase 2: BENCH_SERVE_NET_CLIENTS (default 8)
+    threaded `Client`s solve over TCP against a 2-replica router
+    (chaos-on unless BENCH_SERVE_CHAOS=0); the row records
+    `p50/p99_latency_seconds` and `serve_throughput_req_per_sec` from
+    the router's latency window plus the gateway byte/reject
+    counters."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from mpisppy_tpu.utils.platform import (enable_f64_if_cpu,
+                                            ensure_cpu_backend)
+    ensure_cpu_backend()
+
+    from mpisppy_tpu import telemetry
+    from mpisppy_tpu.models import farmer
+    from mpisppy_tpu.opt.ph import PH
+    from mpisppy_tpu.serve import compile_cache as cc
+    from mpisppy_tpu.serve.net.client import Client
+    from mpisppy_tpu.serve.net.gateway import Gateway
+    from mpisppy_tpu.serve.router import Router
+    from mpisppy_tpu.serve.service import stack_superstep_args
+
+    on_tpu = not enable_f64_if_cpu()
+    S = int(os.environ.get("BENCH_SCENS", 3))
+    n_cli = int(os.environ.get("BENCH_SERVE_NET_CLIENTS", 8))
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": 50, "convthresh": 1e-4,
+            "pdhg_eps": 1e-6}
+    dtype = np.float32 if on_tpu else np.float64
+
+    # -- phase 1: AOT persistence cold-start A/B ----------------------
+    with tempfile.TemporaryDirectory(prefix="mtaot-bench-") as aot_dir:
+        os.environ["MPISPPY_TPU_COMPILE_CACHE_DIR"] = aot_dir
+        phs = []
+        for _ in range(2):
+            ph = PH(dict(opts), [f"s{i}" for i in range(S)],
+                    batch=farmer.build_batch(S, dtype=dtype))
+            ph.Iter0()
+            phs.append(ph)
+        args = stack_superstep_args(phs)
+
+        import jax
+        t0 = time.monotonic()
+        exe = cc.CompileCache().get(
+            phs[0].batch, opts, model="farmer").batched_superstep(args)
+        jax.block_until_ready(exe(*args).conv)
+        cold_trace = time.monotonic() - t0
+
+        warm_cache = cc.CompileCache()
+        t0 = time.monotonic()
+        exe = warm_cache.get(
+            phs[0].batch, opts, model="farmer").batched_superstep(args)
+        jax.block_until_ready(exe(*args).conv)
+        cold_warm = time.monotonic() - t0
+        aot_hits = warm_cache.stats()["aot_loads"]
+        del os.environ["MPISPPY_TPU_COMPILE_CACHE_DIR"]
+
+    # -- phase 2: open socket load through the gateway ----------------
+    chaos_on = os.environ.get("BENCH_SERVE_CHAOS", "1") != "0"
+    r_opts = {
+        "serve_replicas": 2, "serve_max_batch": 1,
+        "serve_restart_backoff": 0.01,
+        "serve_restart_backoff_cap": 0.05,
+        "router_tick": 0.01, "router_probe_interval": 0.02,
+        "router_hedge_threshold": 1.0,
+        "router_breaker_backoff": 0.05,
+        "router_breaker_backoff_cap": 0.5,
+        "router_drain_deadline": 0.3,
+        "telemetry": True,
+    }
+    if chaos_on:
+        r_opts["chaos"] = {"replica_crash": 1, "slow_replica": 0.02,
+                           "chaos_replica": 0}
+    gw = Gateway({"telemetry": True}, router=Router(r_opts).start())
+    gw.start()
+    host, port = gw.address
+    outcomes = [None] * n_cli
+
+    def one(i):
+        with Client(host, port, request_timeout=600.0) as cli:
+            outcomes[i] = cli.solve(
+                farmer.build_batch(S, seedoffset=i, dtype=dtype), opts,
+                timeout=600, model="farmer",
+                idempotency_key=f"bench-net-{i}")
+
+    t0 = time.time()
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(n_cli)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.time() - t0
+    ok = sum(1 for r in outcomes
+             if r is not None and r.get("status") == "ok")
+    st = gw.router.stats()
+    gw_counters = telemetry.gateway_counters()
+    gw.shutdown()
+    gw.router.shutdown(timeout=10)
+
+    out = {
+        "metric": "serve_net_throughput_req_per_sec",
+        "value": round(n_cli / wall, 3) if ok == n_cli else -1,
+        "unit": "req/s", "vs_baseline": 0,
+        "serve_throughput_req_per_sec": round(n_cli / wall, 3),
+        "p50_latency_seconds": (round(st["p50"], 4)
+                                if st["p50"] is not None else -1),
+        "p99_latency_seconds": (round(st["p99"], 4)
+                                if st["p99"] is not None else -1),
+        "cold_start_seconds": round(cold_warm, 4),
+        "cold_start_seconds_trace": round(cold_trace, 4),
+        "aot_cache_hits": aot_hits,
+        "clients": n_cli, "ok": ok, "wall_s": round(wall, 3),
+        "scens": S, "chaos": chaos_on,
+        "replica_restarts": st["replica_restarts"],
+        "device": ("TPU" if on_tpu else "cpu"),
+        **gw_counters}
+    if ok != n_cli:
+        out["note"] = f"{n_cli - ok} request(s) not ok"
+    print(json.dumps(out))
+
+
 def worker_farmer_stream():
     """BENCH_MODEL=farmer_stream: StreamingPH over the streamed farmer
     universe — default S=1,000,000 scenarios, which NEVER materialize:
@@ -998,6 +1131,8 @@ def worker():
         return worker_sslp()
     if model == "serve":
         return worker_serve()
+    if model == "serve_net":
+        return worker_serve_net()
     if model == "farmer_stream":
         return worker_farmer_stream()
     if model == "farmer_shard":

@@ -88,3 +88,15 @@ def shutdown_service(timeout=60.0):
         r, _router = _router, None
     if r is not None:
         r.shutdown(timeout)
+
+
+def start_gateway(options=None, host="127.0.0.1", port=0):
+    """Start a network `Gateway` (serve/net/) over the process-global
+    router — the socket front door to the same five calls.  The
+    gateway does NOT own the router: `shutdown_service()` still owns
+    its lifecycle, and a gateway shutdown only closes the socket edge.
+    Returns the started Gateway; read `.address` for the bound
+    (host, port)."""
+    from .net.gateway import Gateway
+    return Gateway(options, router=start_service(options),
+                   host=host, port=port).start()

@@ -18,22 +18,56 @@ Per bucket this module holds:
     standalone `PH.ph_main` runs (same pure function, same solver
     config, same shapes), which makes the serve batch=1 result
     bitwise-identical to a standalone run;
-  * per-batch-width AOT executables (`jax.jit(jax.vmap(...)).lower()
-    .compile()`) for the coalesced B>1 path.
+  * per-batch-width AOT executables of `vmap(ph_superstep)` for the
+    coalesced B>1 path, built through `jax.export` (see below).
 
-The cache also counts `serve.compile_cache.{hit,miss}` per REQUEST
-(telemetry counters when enabled, plain ints always) — the acceptance
-signal "N concurrent same-shape requests, one compilation".  Wire-up
-to jax's PERSISTENT compilation cache (warm process restarts skip XLA)
-is `utils.platform.enable_compile_cache`, called from
-`SolverService.start`.
+AOT persistence to disk
+-----------------------
+When `MPISPPY_TPU_COMPILE_CACHE_DIR` is set, each batched executable
+is additionally serialized via `jax.export.export(...).serialize()`
+into `$MPISPPY_TPU_COMPILE_CACHE_DIR/aot/<fingerprint>.mtaot`, under a
+fingerprint covering the full `bucket_key` PLUS batch width, jax and
+jaxlib versions, backend, argument treedef, and the x64 flag — the
+things that can silently change the traced program between processes.
+A fresh replica (`warm_from` on a new incarnation, a rolling restart,
+a cold process) deserializes the artifact instead of re-tracing: the
+Python-level trace of `vmap(ph_superstep)` — the dominant cold-start
+cost — is skipped entirely.  Validation mirrors the MTSHARD1 shard
+discipline (streaming/store.py): magic + header JSON + payload CRC32
+checked on every load, and ANY mismatch — torn file, foreign
+fingerprint, version skew — falls back silently to tracing, counted in
+`cache.aot_load_failures`.  Loads that succeed count
+`cache.aot_loads`, saves `cache.aot_saves` (telemetry counters when
+enabled, plain ints always — `telemetry.gateway_counters()`).
+
+Both the trace and the warm path execute `jax.jit(exported.call)` over
+the SAME exported artifact shape (flat array leaves in, flat leaves
+out), so a warm-started replica's batched results are identical to a
+freshly-traced one's — the fallback is behaviorally invisible.
+
+The cache also counts `serve.compile_cache.{hit,miss}` per REQUEST —
+the acceptance signal "N concurrent same-shape requests, one
+compilation".  Wire-up to jax's own persistent XLA cache is
+`utils.platform.enable_compile_cache`, called from
+`SolverService.start`; the jax.export layer above it persists the
+*traced program*, which jax's cache does not.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
+import struct
 import threading
+import zlib
 
+from .. import global_toc
 from .. import telemetry as _telemetry
+
+AOT_MAGIC = b"MTAOTX1\0"
+AOT_FORMAT = 1
+_AOT_SUFFIX = ".mtaot"
 
 
 def width_bucket(n, floor=1):
@@ -87,6 +121,98 @@ def bucket_key(batch, options=None, model=None, backend=None):
     )
 
 
+# -- AOT disk layer --------------------------------------------------------
+
+def aot_cache_dir():
+    """The on-disk AOT executable directory, or None when persistence
+    is off (`MPISPPY_TPU_COMPILE_CACHE_DIR` unset/empty)."""
+    root = os.environ.get("MPISPPY_TPU_COMPILE_CACHE_DIR")
+    if not root:
+        return None
+    return os.path.join(root, "aot")
+
+
+def aot_fingerprint(key, B, treedef_repr):
+    """The cache key of one persisted executable: sha256 over the full
+    bucket key + batch width + jax/jaxlib versions + backend + argument
+    treedef + x64 flag.  Anything that can change the traced program
+    between processes is in here — a mismatch means "trace, don't
+    load"."""
+    import jax
+    try:
+        import jaxlib.version
+        jaxlib_v = jaxlib.version.__version__
+    except Exception:                  # pragma: no cover - old layouts
+        jaxlib_v = "unknown"
+    ident = (repr(key), int(B), jax.__version__, jaxlib_v,
+             str(jax.default_backend()), str(treedef_repr),
+             bool(jax.config.jax_enable_x64))
+    return hashlib.sha256(repr(ident).encode("utf-8")).hexdigest()
+
+
+def _aot_encode(fingerprint, B, payload):
+    """One persisted executable's byte image: magic + header JSON +
+    serialized jax.export payload, CRC-stamped like an MTSHARD1
+    shard."""
+    import jax
+    header = {
+        "aot_format": AOT_FORMAT,
+        "fingerprint": fingerprint,
+        "batch_width": int(B),
+        "jax_version": jax.__version__,
+        "backend": str(jax.default_backend()),
+        "payload_len": len(payload),
+        "payload_crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+    }
+    hjson = json.dumps(header, sort_keys=True).encode("utf-8")
+    return AOT_MAGIC + struct.pack("<I", len(hjson)) + hjson + payload
+
+
+def _aot_decode(data, fingerprint):
+    """Validate + strip one persisted executable; raises ValueError on
+    ANY mismatch (torn, foreign, corrupt, fingerprint/format skew)."""
+    if len(data) < len(AOT_MAGIC) + 4:
+        raise ValueError("truncated AOT file")
+    if data[:len(AOT_MAGIC)] != AOT_MAGIC:
+        raise ValueError("bad AOT magic")
+    (hlen,) = struct.unpack(
+        "<I", data[len(AOT_MAGIC):len(AOT_MAGIC) + 4])
+    hstart = len(AOT_MAGIC) + 4
+    if hstart + hlen > len(data):
+        raise ValueError("truncated AOT header")
+    header = json.loads(data[hstart:hstart + hlen].decode("utf-8"))
+    if int(header.get("aot_format", -1)) != AOT_FORMAT:
+        raise ValueError(f"AOT format {header.get('aot_format')!r}")
+    if header.get("fingerprint") != fingerprint:
+        raise ValueError("AOT fingerprint mismatch")
+    payload = data[hstart + hlen:]
+    if len(payload) != int(header.get("payload_len", -1)):
+        raise ValueError("AOT payload length mismatch")
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    if crc != int(header.get("payload_crc32", -1)):
+        raise ValueError("AOT payload CRC mismatch")
+    return payload
+
+
+class _BatchedRunner:
+    """One batch width's executable: flat leaves through the exported
+    artifact, pytree structure restored at the edges.  Callable exactly
+    like the jitted vmap it replaces (same 9 positional superstep args,
+    same PHState out) — `service._run_batched` can't tell warm from
+    traced, which is the point."""
+
+    def __init__(self, call, out_treedef):
+        self._call = call
+        self._out_treedef = out_treedef
+
+    def __call__(self, *args):
+        import jax
+        leaves = jax.tree_util.tree_leaves(args)
+        out = self._call(*leaves)
+        return jax.tree_util.tree_unflatten(self._out_treedef,
+                                            list(out))
+
+
 class CompiledBucket:
     """One bucket's executables (see module docstring).  Built lazily
     by the service's single dispatch thread, so `fused_superstep`'s
@@ -94,50 +220,140 @@ class CompiledBucket:
     bucket object itself is only ever driven from the dispatch
     thread (sequentially across worker restarts)."""
 
-    def __init__(self, key, options):
+    def __init__(self, key, options, owner=None):
         from ..ops.pdhg import PDHGSolver
         from ..phbase import fused_superstep
         self.key = key
         self.solver = PDHGSolver.from_options(options)
         self.superstep = fused_superstep(self.solver)
-        self._batched = {}            # B -> AOT-compiled executable
+        self._batched = {}            # B -> _BatchedRunner
         self._lock = threading.Lock()
+        self._owner = owner
         self.aot_compiles = 0
 
+    def _aot_account(self, what):
+        tel = self._owner._tel if self._owner is not None \
+            else _telemetry.get()
+        tel.counter(f"cache.{what}").inc()
+        if self._owner is not None:
+            with self._owner._lock:
+                setattr(self._owner, what,
+                        getattr(self._owner, what) + 1)
+
+    def _aot_load(self, path, fingerprint):
+        """Deserialize a persisted executable, or None (counted) when
+        the file is absent, torn, corrupt, or fingerprint-skewed —
+        the silent-fallback half of the AOT contract."""
+        from jax import export as jax_export
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                payload = _aot_decode(f.read(), fingerprint)
+            exported = jax_export.deserialize(payload)
+        except Exception as exc:
+            self._aot_account("aot_load_failures")
+            global_toc("WARNING: AOT cache entry rejected "
+                       f"({os.path.basename(path)}): {exc}")
+            return None
+        self._aot_account("aot_loads")
+        return exported
+
+    def _aot_save(self, path, fingerprint, B, exported):
+        from ..resilience.checkpoint import atomic_write
+        try:
+            data = _aot_encode(fingerprint, B, exported.serialize())
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            atomic_write(path, data)
+        except Exception as exc:       # pragma: no cover - disk full &c
+            global_toc(f"WARNING: AOT cache write failed: {exc}")
+            return
+        self._aot_account("aot_saves")
+
     def batched_superstep(self, example_args):
-        """AOT executable of `vmap(ph_superstep)` over a leading
-        request axis, lowered+compiled once per batch width B from the
-        stacked `example_args` (the superstep's 9 positional args, each
-        leaf with a leading B axis)."""
-        import functools
-
-        import jax
-
-        from ..phbase import ph_superstep
-
+        """The executable of `vmap(ph_superstep)` over a leading
+        request axis for batch width B (from the stacked
+        `example_args`: the superstep's 9 positional args, each leaf
+        with a leading B axis) — deserialized from the AOT disk cache
+        when a matching artifact exists, traced (once per width) and
+        persisted otherwise."""
         B = int(example_args[1].shape[0])     # rho: (B, S, K)
         with self._lock:
-            exe = self._batched.get(B)
-        if exe is not None:
-            return exe
-        fn = jax.jit(jax.vmap(functools.partial(ph_superstep, self.solver)))
-        exe = fn.lower(*example_args).compile()
+            runner = self._batched.get(B)
+        if runner is not None:
+            return runner
+        runner = self._build_runner(B, example_args)
         with self._lock:
             if B not in self._batched:
-                self._batched[B] = exe
+                self._batched[B] = runner
                 self.aot_compiles += 1
         return self._batched[B]
 
+    def _build_runner(self, B, example_args):
+        import functools
+
+        import jax
+        from jax import export as jax_export
+
+        from ..phbase import ph_superstep
+
+        tu = jax.tree_util
+        args = tuple(example_args)
+        leaves, in_treedef = tu.tree_flatten(args)
+        # superstep out = a PHState shaped like the (stacked) state in
+        out_treedef = tu.tree_structure(args[0])
+        path = None
+        d = aot_cache_dir()
+        fp = aot_fingerprint(self.key, B, repr(in_treedef))
+        if d is not None:
+            path = os.path.join(d, fp + _AOT_SUFFIX)
+            exported = self._aot_load(path, fp)
+            if exported is not None:
+                return _BatchedRunner(jax.jit(exported.call),
+                                      out_treedef)
+
+        # trace path: export the flat-leaf wrapper (custom pytrees like
+        # PHState/ScenarioBatch don't cross jax.export's serialization
+        # boundary — positional array leaves do), then run THROUGH the
+        # exported artifact so warm and traced replicas execute the
+        # same program shape
+        def flat_fn(*flat):
+            a = tu.tree_unflatten(in_treedef, list(flat))
+            out = jax.vmap(
+                functools.partial(ph_superstep, self.solver))(*a)
+            return tuple(tu.tree_leaves(out))
+
+        try:
+            exported = jax_export.export(jax.jit(flat_fn))(*leaves)
+        except Exception as exc:
+            # un-exportable program: plain AOT lower+compile, no disk
+            # persistence for this bucket (counted so it's visible)
+            self._aot_account("aot_export_failures")
+            global_toc(f"WARNING: jax.export failed for bucket "
+                       f"(B={B}): {exc!r}; falling back to "
+                       "lower().compile() without persistence")
+            fn = jax.jit(jax.vmap(
+                functools.partial(ph_superstep, self.solver)))
+            return fn.lower(*args).compile()
+        if path is not None:
+            self._aot_save(path, fp, B, exported)
+        return _BatchedRunner(jax.jit(exported.call), out_treedef)
+
 
 class CompileCache:
-    """Bucket table + per-request hit/miss accounting."""
+    """Bucket table + per-request hit/miss accounting + the AOT disk
+    layer's load/save/failure counts."""
 
     def __init__(self, tel=None):
         self._tel = tel if tel is not None else _telemetry.get()
         self._buckets = {}
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self.hits = 0
         self.misses = 0
+        self.aot_loads = 0
+        self.aot_load_failures = 0
+        self.aot_saves = 0
+        self.aot_export_failures = 0
 
     def get(self, batch, options=None, model=None):
         """The CompiledBucket for one request (building it on first
@@ -147,7 +363,7 @@ class CompileCache:
         with self._lock:
             entry = self._buckets.get(key)
             if entry is None:
-                entry = CompiledBucket(key, options)
+                entry = CompiledBucket(key, options, owner=self)
                 self._buckets[key] = entry
                 self.misses += 1
                 self._tel.counter("serve.compile_cache.miss").inc()
@@ -159,7 +375,11 @@ class CompileCache:
     def stats(self):
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
-                    "buckets": len(self._buckets)}
+                    "buckets": len(self._buckets),
+                    "aot_loads": self.aot_loads,
+                    "aot_load_failures": self.aot_load_failures,
+                    "aot_saves": self.aot_saves,
+                    "aot_export_failures": self.aot_export_failures}
 
 
 def merged_stats(caches):
@@ -167,13 +387,18 @@ def merged_stats(caches):
     replica owns its own cache handle, so per-replica stats only tell
     half the story).  `buckets` sums the PER-CACHE bucket counts: the
     same logical shape bucket compiled in two replicas IS two
-    compilations — the fault-isolation price the replica split pays,
-    and the signal this aggregate exists to expose."""
-    out = {"hits": 0, "misses": 0, "buckets": 0, "caches": 0}
+    compilations — the fault-isolation price the replica split pays
+    (which the AOT disk layer now refunds: the second replica LOADS
+    what the first traced), and the signal this aggregate exists to
+    expose."""
+    out = {"hits": 0, "misses": 0, "buckets": 0, "caches": 0,
+           "aot_loads": 0, "aot_load_failures": 0, "aot_saves": 0,
+           "aot_export_failures": 0}
     for c in caches:
         s = c.stats()
-        out["hits"] += s["hits"]
-        out["misses"] += s["misses"]
-        out["buckets"] += s["buckets"]
+        for k in ("hits", "misses", "buckets", "aot_loads",
+                  "aot_load_failures", "aot_saves",
+                  "aot_export_failures"):
+            out[k] += s.get(k, 0)
         out["caches"] += 1
     return out

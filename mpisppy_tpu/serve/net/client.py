@@ -1,0 +1,205 @@
+"""Blocking wire-protocol client for the serve gateway.
+
+One `Client` wraps one TCP connection and speaks `protocol`'s framed
+request/response exchange: `submit / poll / result / solve / health /
+drain / roll`.  Failure handling is deliberately boring:
+
+  * **connect timeout** and **request timeout** bound every socket
+    operation (`socket.create_connection(timeout=)`, `settimeout`);
+  * a torn connection (ConnectionError / OSError / mid-frame EOF)
+    triggers **capped-jitter reconnect** built on the shared
+    `resilience.restart_delay` pacing policy, then ONE resend of the
+    in-flight request.  Every submit/solve carries an idempotency key
+    (auto-generated uuid when the caller gave none), so a resend after
+    a half-delivered request is deduplicated server-side — the wire
+    half of the exactly-once contract;
+  * a `result` wait stretches the socket timeout to the request's own
+    timeout plus a grace, so slow solves aren't misread as dead peers.
+
+Layering: jax-free, like the rest of `serve/net/` (AST +
+fresh-interpreter guarded in tests/test_net_gateway.py).
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import time
+import uuid
+from dataclasses import dataclass
+
+from ...resilience import restart_delay
+from . import protocol as P
+
+
+@dataclass(frozen=True)
+class NetHandle:
+    """A submitted request as seen from the client side: the router's
+    handle id plus the idempotency key the client stamped on it (the
+    key is what survives a reconnect; the id is what poll/result
+    use)."""
+    id: int
+    idempotency_key: str
+
+
+class ClientError(RuntimeError):
+    """The server answered with ok=False: carries the wire error code
+    (protocol.ERROR_CODES) as `.code`."""
+
+    def __init__(self, code, message):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+
+
+class Client:
+    """Blocking gateway client (see module docstring)."""
+
+    def __init__(self, host, port, token="", connect_timeout=5.0,
+                 request_timeout=60.0, reconnect_backoff=0.05,
+                 reconnect_cap=2.0, max_reconnects=8, jitter_seed=None,
+                 max_payload=P.DEFAULT_MAX_PAYLOAD):
+        self.host = host
+        self.port = int(port)
+        self.token = token
+        self.connect_timeout = float(connect_timeout)
+        self.request_timeout = float(request_timeout)
+        self.reconnect_backoff = float(reconnect_backoff)
+        self.reconnect_cap = float(reconnect_cap)
+        self.max_reconnects = int(max_reconnects)
+        self.max_payload = int(max_payload)
+        self._rng = random.Random(jitter_seed)
+        self._sock = None
+        self.reconnects = 0            # lifetime count (tests/bench)
+
+    # -- connection management --------------------------------------------
+    def _connect(self):
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout)
+        sock.settimeout(self.request_timeout)
+        self._sock = sock
+        return sock
+
+    def _ensure(self):
+        return self._sock if self._sock is not None else self._connect()
+
+    def _drop(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self):
+        self._drop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- request core ------------------------------------------------------
+    def _request(self, header, payload=b"", timeout=None):
+        """One framed exchange, with reconnect-and-resend on transport
+        failure.  Safe to resend because every mutating verb carries an
+        idempotency key.  Returns (response_header, response_payload);
+        raises ClientError on an ok=False response, ConnectionError
+        when the reconnect budget is spent."""
+        attempt = 0
+        while True:
+            try:
+                sock = self._ensure()
+                if timeout is not None:
+                    sock.settimeout(float(timeout))
+                try:
+                    P.write_message(sock, header, payload)
+                    resp, rpayload = P.read_message(
+                        sock, max_payload=self.max_payload)
+                finally:
+                    if timeout is not None:
+                        sock.settimeout(self.request_timeout)
+                if resp is None:
+                    raise P.ProtocolError("server closed the connection")
+            except (ConnectionError, OSError, P.ProtocolError) as exc:
+                self._drop()
+                attempt += 1
+                self.reconnects += 1
+                if attempt > self.max_reconnects:
+                    raise ConnectionError(
+                        f"gateway unreachable after {attempt - 1} "
+                        f"reconnect(s): {exc}") from exc
+                # capped exponential backoff with full jitter: the
+                # shared restart pacing policy scaled by U(0.5, 1)
+                delay = restart_delay(attempt, self.reconnect_backoff,
+                                      self.reconnect_cap)
+                time.sleep(delay * (0.5 + 0.5 * self._rng.random()))
+                continue
+            if not resp.get("ok", False):
+                raise ClientError(resp.get("error_code", P.E_INTERNAL),
+                                  resp.get("error", ""))
+            return resp, rpayload
+
+    def _header(self, verb, **fields):
+        hdr = {"kind": "request", "verb": verb, "token": self.token}
+        hdr.update({k: v for k, v in fields.items() if v is not None})
+        return hdr
+
+    # -- verbs -------------------------------------------------------------
+    def submit(self, batch, options=None, scenario_names=None,
+               deadline=None, model=None, priority=None,
+               idempotency_key=None):
+        """Enqueue one solve; returns a NetHandle immediately.  An
+        immediately-rejected request still gets a handle — `result`
+        reports the structured rejection."""
+        key = idempotency_key or f"net-{uuid.uuid4().hex}"
+        hdr = self._header(
+            "submit", options=options, scenario_names=scenario_names,
+            deadline=deadline, model=model, priority=priority,
+            idempotency_key=key)
+        resp, _ = self._request(hdr, P.encode_batch(batch))
+        return NetHandle(int(resp["result"]["handle"]), key)
+
+    def poll(self, handle):
+        resp, _ = self._request(self._header("poll", handle=handle.id))
+        return resp["result"]["state"]
+
+    def result(self, handle, timeout=None):
+        """Block for the structured result dict (arrays restored
+        bit-exact from the npz payload).  The socket wait stretches to
+        `timeout` + grace so a slow solve isn't misread as a dead
+        peer."""
+        wire_timeout = None if timeout is None \
+            else float(timeout) + 10.0
+        resp, payload = self._request(
+            self._header("result", handle=handle.id, timeout=timeout),
+            timeout=wire_timeout)
+        return P.decode_result(resp["result"], payload)
+
+    def solve(self, batch, options=None, timeout=None, **kwargs):
+        """submit + result in one exchange (one frame each way)."""
+        key = kwargs.pop("idempotency_key", None) \
+            or f"net-{uuid.uuid4().hex}"
+        hdr = self._header("solve", options=options, timeout=timeout,
+                           idempotency_key=key, **kwargs)
+        wire_timeout = None if timeout is None \
+            else float(timeout) + 10.0
+        resp, payload = self._request(hdr, P.encode_batch(batch),
+                                      timeout=wire_timeout)
+        return P.decode_result(resp["result"], payload)
+
+    def health(self):
+        resp, _ = self._request(self._header("health"))
+        return resp["result"]
+
+    def drain(self, deadline=5.0):
+        resp, _ = self._request(
+            self._header("drain", deadline=deadline),
+            timeout=float(deadline) + 10.0)
+        return resp["result"]
+
+    def roll(self, timeout=120.0):
+        """Ask the gateway for a zero-downtime rolling restart of the
+        whole replica set; blocks until every slot has been replaced."""
+        resp, _ = self._request(self._header("roll"), timeout=timeout)
+        return resp["result"]["rolled"]

@@ -72,8 +72,10 @@ class TokenBucket:
 
     def take(self, now=None):
         now = time.monotonic() if now is None else now
-        self.tokens = min(self.burst,
-                          self.tokens + (now - self.stamp) * self.rate)
+        # clamp: a `now` captured before this bucket was lazily created
+        # must not debit the fresh burst (negative elapsed)
+        self.tokens = min(self.burst, self.tokens
+                          + max(0.0, now - self.stamp) * self.rate)
         self.stamp = now
         if self.tokens >= 1.0:
             self.tokens -= 1.0
@@ -259,6 +261,7 @@ class Router:
         self._last_brownout_eval = 0.0
         self._last_probe = 0.0
         self._lock = threading.RLock()
+        self._replace_lock = threading.Lock()  # serialize _replace_slot
         self._rids = itertools.count(1)
         self._requests = {}            # rid -> RouterRequest (all)
         self._open = {}                # rid -> RouterRequest (unresolved)
@@ -586,8 +589,16 @@ class Router:
         """The corpse path: quarantine poison suspects, drain the dead
         replica (leftovers checkpointed), start a fresh incarnation
         warmed from the checkpoint, adopt the warmed handles, and let
-        the scan replay whatever is left without a live handle."""
+        the scan replay whatever is left without a live handle.
+        Serialized across threads (monitor vs. roll()): a slot already
+        condemned by the other caller is skipped, not replaced twice."""
+        with self._replace_lock:
+            return self._replace_slot_locked(slot, reason)
+
+    def _replace_slot_locked(self, slot, reason=""):
         corpse = self.replica_set[slot]
+        if corpse.condemned:
+            return
         corpse.condemned = True
         self._tel.event("router.replica_down", slot=slot,
                         replica=corpse.name, reason=str(reason)[:500])
@@ -620,6 +631,34 @@ class Router:
             for rreq in list(self._open.values()):
                 rreq.handles = [(r, h) for r, h in rreq.handles
                                 if r is not corpse]
+
+    def roll(self, reason="rolling_restart", on_slot=None):
+        """Zero-downtime rolling restart: condemn ONE slot at a time
+        through the replace-and-replay machinery while the peers absorb
+        traffic — in-flight requests on the condemned replica survive
+        via warm_from adoption, bare-handle replay, and the idempotency
+        table (re-submission of an already-rolled key returns the
+        original handle).  Waits for each fresh incarnation to report
+        healthy before condemning the next peer, so the set is never
+        more than one replica down.  Returns the number of replicas
+        replaced; counts `rolled_replicas` per slot."""
+        self.start()
+        rolled = 0
+        for slot in range(len(self.replica_set)):
+            self._replace_slot(slot, reason=reason)
+            fresh = self.replica_set[slot]
+            end = time.monotonic() + self.drain_deadline + 10.0
+            while time.monotonic() < end:
+                if fresh.health()["failed"] is None:
+                    break
+                time.sleep(self.tick_interval)
+            rolled += 1
+            self._count("rolled_replicas")
+            self._tel.event("router.rolled_slot", slot=slot,
+                            fresh=fresh.name)
+            if on_slot is not None:
+                on_slot(slot, fresh.name)
+        return rolled
 
     def _scan_requests(self, now):
         with self._lock:

@@ -81,6 +81,43 @@ from .request import (FAILED, OK, QUEUED, REJECTED, RUNNING, RequestHandle,
 _BACKEND_LOCK = threading.Lock()
 
 
+def stack_superstep_args(phs):
+    """Stack N same-bucket PH instances' superstep arguments along a
+    leading request axis: the 9 positional args of
+    `phbase.ph_superstep`, each leaf gaining a B-long leading axis —
+    exactly what `CompiledBucket.batched_superstep` lowers over.
+    Module-level so the bench's cold-start A/B and the AOT tests can
+    build example args without a running service."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = phs[0].batch.c.dtype
+
+    def stack(trees):
+        # flatten/unflatten (NOT tree_map over multiple trees):
+        # meta equality on model_meta numpy arrays is ill-defined,
+        # but same-bucket treedefs are structurally identical
+        flat = [jax.tree_util.tree_flatten(t) for t in trees]
+        treedef = flat[0][1]
+        return jax.tree_util.tree_unflatten(
+            treedef,
+            [jnp.stack(leaves) for leaves in
+             zip(*[f[0] for f in flat])])
+
+    return (
+        stack([ph.state for ph in phs]),
+        jnp.stack([ph.rho for ph in phs]),
+        jnp.asarray([ph.W_on for ph in phs], dtype),
+        jnp.asarray([ph.prox_on for ph in phs], dtype),
+        jnp.stack([ph.lb_eff for ph in phs]),
+        jnp.stack([ph.ub_eff for ph in phs]),
+        jnp.stack([jnp.asarray(ph.superstep_eps, dtype)
+                   for ph in phs]),
+        stack([ph.prep for ph in phs]),
+        stack([ph.batch for ph in phs]),
+    )
+
+
 class SolverService:
     def __init__(self, options=None, cache=None):
         o = dict(options or {})
@@ -591,38 +628,13 @@ class SolverService:
 
         reqs = [req for req, _ in live]
         phs = [ph for _, ph in live]
-        dtype = phs[0].batch.c.dtype
-
-        def stack(trees):
-            # flatten/unflatten (NOT tree_map over multiple trees):
-            # meta equality on model_meta numpy arrays is ill-defined,
-            # but same-bucket treedefs are structurally identical
-            flat = [jax.tree_util.tree_flatten(t) for t in trees]
-            treedef = flat[0][1]
-            import jax.numpy as jnp
-            return jax.tree_util.tree_unflatten(
-                treedef,
-                [jnp.stack(leaves) for leaves in
-                 zip(*[f[0] for f in flat])])
 
         def unstack(tree, i):
             leaves, treedef = jax.tree_util.tree_flatten(tree)
             return jax.tree_util.tree_unflatten(
                 treedef, [leaf[i] for leaf in leaves])
 
-        import jax.numpy as jnp
-        args = (
-            stack([ph.state for ph in phs]),
-            jnp.stack([ph.rho for ph in phs]),
-            jnp.asarray([ph.W_on for ph in phs], dtype),
-            jnp.asarray([ph.prox_on for ph in phs], dtype),
-            jnp.stack([ph.lb_eff for ph in phs]),
-            jnp.stack([ph.ub_eff for ph in phs]),
-            jnp.stack([jnp.asarray(ph.superstep_eps, dtype)
-                       for ph in phs]),
-            stack([ph.prep for ph in phs]),
-            stack([ph.batch for ph in phs]),
-        )
+        args = stack_superstep_args(phs)
         exe = engine.batched_superstep(args)
         state, rest = args[0], args[1:]
         limits = [int(ph.options.get("PHIterLimit", 100)) for ph in phs]

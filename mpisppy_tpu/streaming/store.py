@@ -167,7 +167,12 @@ def _batch_from_payload(z):
         node_of=np.asarray(z["tree_node_of"]),
         prob=np.asarray(z["tree_prob"]),
         num_nodes=int(z["tree_num_nodes"]),
-        stage_of=opt("tree_stage_of"),
+        # stage_of is pytree AUX data (TreeInfo meta field): restore
+        # the canonical tuple-of-ints form every model builds, not an
+        # ndarray — array aux breaks treedef equality (and with it the
+        # jit caches) when a decoded batch meets a fresh one
+        stage_of=(tuple(np.asarray(z["tree_stage_of"]).tolist())
+                  if "tree_stage_of" in z else None),
         nonant_names=tuple(np.asarray(z["tree_nonant_names"]).tolist()),
         scen_names=tuple(np.asarray(z["tree_scen_names"]).tolist()),
     )

@@ -335,3 +335,28 @@ def router_counters(registry=None):
          if reg.enabled else None)
     out["router_brownout_level"] = int(g.value) if g is not None else 0
     return out
+
+
+def gateway_counters(registry=None):
+    """Network-edge (serve/net gateway) + AOT-disk-cache counter dict
+    for bench JSON — stable keys whether or not telemetry was on,
+    mirroring router_counters().  `gateway_rejects_by_code` expands the
+    `gateway.rejects.<code>` counter family (protocol.ERROR_CODES keys)
+    into a dict, the same prefix-scan shape as wheel_slice_bounds."""
+    reg = registry if registry is not None else get().registry
+    names = ("gateway.requests", "gateway.bytes_in",
+             "gateway.bytes_out", "gateway.rolls", "gateway.drains",
+             "cache.aot_loads", "cache.aot_load_failures",
+             "cache.aot_saves", "cache.aot_export_failures")
+    vals = ({k: c.value for k, c in reg._counters.items()}
+            if reg.enabled else {})
+    out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
+    g = (reg._gauges.get("gateway.active_connections")
+         if reg.enabled else None)
+    out["gateway_active_connections"] = (
+        int(g.value) if g is not None else 0)
+    out["gateway_rejects_by_code"] = (
+        {k[len("gateway.rejects."):]: int(c.value)
+         for k, c in reg._counters.items()
+         if k.startswith("gateway.rejects.")} if reg.enabled else {})
+    return out

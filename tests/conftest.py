@@ -62,3 +62,7 @@ def pytest_configure(config):
         "corpus, readahead, quarantine + certified-gap accounting, "
         "storage-cursor resume); these RUN under tier-1's "
         "`-m 'not slow'`")
+    config.addinivalue_line(
+        "markers", "net: network front-door tests (wire protocol, "
+        "gateway/client over real sockets, AOT executable persistence, "
+        "rolling restart); these RUN under tier-1's `-m 'not slow'`")

@@ -159,7 +159,9 @@ def test_cache_counts_hits_and_misses():
     e2 = cache.get(farmer.build_batch(3), FAST_OPTS)
     assert e1 is e2
     cache.get(farmer.build_batch(4), FAST_OPTS)
-    assert cache.stats() == {"hits": 1, "misses": 2, "buckets": 2}
+    assert cache.stats() == {"hits": 1, "misses": 2, "buckets": 2,
+                             "aot_loads": 0, "aot_load_failures": 0,
+                             "aot_saves": 0, "aot_export_failures": 0}
 
 
 # -- admission control (no dispatch thread needed) ------------------------
@@ -428,3 +430,68 @@ def test_warm_from_resubmits_and_solves(tmp_path):
         assert np.isfinite(res["conv"])
     finally:
         svc2.shutdown()
+
+
+# -- api error paths (module-global front door) ----------------------------
+
+def _api_isolated():
+    """Import serve.api and stash the process-global router so these
+    tests can't leak state into (or inherit it from) other tests."""
+    from mpisppy_tpu.serve import api
+    return api
+
+
+@pytest.fixture
+def api_mod():
+    api = _api_isolated()
+    prev = api._router
+    api._router = None
+    yield api
+    api.shutdown_service(timeout=30)
+    api._router = prev
+
+
+def test_api_result_unknown_handle(api_mod):
+    """result()/poll() on a handle nobody minted: structured `unknown`,
+    never an exception — both before the service exists and against a
+    live router that has no such request id."""
+    from mpisppy_tpu.serve.request import RouterHandle
+
+    ghost = RouterHandle(id=10**9)
+    # no service started at all
+    assert api_mod.get_service() is None
+    assert api_mod.poll(ghost) == "unknown"
+    res = api_mod.result(ghost)
+    assert res == {"status": "unknown", "request_id": ghost.id}
+    # live router, unknown id: same contract (and still no exception)
+    api_mod.start_service({"serve_replicas": 1})
+    assert api_mod.poll(ghost) == "unknown"
+    res = api_mod.result(ghost, timeout=0.1)
+    assert res["status"] == "unknown" and res["request_id"] == ghost.id
+
+
+def test_api_double_shutdown_is_noop(api_mod):
+    """shutdown_service() twice: the second call finds no router and
+    returns without error (idempotent teardown)."""
+    api_mod.start_service({"serve_replicas": 1})
+    assert api_mod.get_service() is not None
+    api_mod.shutdown_service(timeout=30)
+    assert api_mod.get_service() is None
+    api_mod.shutdown_service(timeout=30)     # must not raise
+    assert api_mod.get_service() is None
+
+
+def test_api_start_after_shutdown_gets_fresh_router(api_mod):
+    """start_service after shutdown_service builds a FRESH router —
+    the old object is gone, and handles minted by the dead router are
+    `unknown` to its replacement."""
+    r1 = api_mod.start_service({"serve_replicas": 1})
+    api_mod.shutdown_service(timeout=30)
+    r2 = api_mod.start_service({"serve_replicas": 1})
+    assert r2 is not r1
+    assert api_mod.get_service() is r2
+    # a handle from the dead incarnation means nothing to the new one
+    from mpisppy_tpu.serve.request import RouterHandle
+    stale = RouterHandle(id=1)
+    assert api_mod.poll(stale) == "unknown"
+    assert api_mod.result(stale)["status"] == "unknown"
