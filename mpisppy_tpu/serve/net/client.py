@@ -15,6 +15,12 @@ drain / roll`.  Failure handling is deliberately boring:
     half of the exactly-once contract;
   * a `result` wait stretches the socket timeout to the request's own
     timeout plus a grace, so slow solves aren't misread as dead peers.
+    With `timeout=None` the server blocks up to ITS cap
+    (`gateway_result_cap`, 600 s default), so the socket stretches to
+    the client's `result_cap` mirror of that value — leaving it at
+    `request_timeout` would misread every solve slower than 60 s as a
+    transport failure and burn the reconnect budget on a healthy
+    request.
 
 Layering: jax-free, like the rest of `serve/net/` (AST +
 fresh-interpreter guarded in tests/test_net_gateway.py).
@@ -55,14 +61,18 @@ class Client:
     """Blocking gateway client (see module docstring)."""
 
     def __init__(self, host, port, token="", connect_timeout=5.0,
-                 request_timeout=60.0, reconnect_backoff=0.05,
-                 reconnect_cap=2.0, max_reconnects=8, jitter_seed=None,
+                 request_timeout=60.0, result_cap=600.0,
+                 reconnect_backoff=0.05, reconnect_cap=2.0,
+                 max_reconnects=8, jitter_seed=None,
                  max_payload=P.DEFAULT_MAX_PAYLOAD):
         self.host = host
         self.port = int(port)
         self.token = token
         self.connect_timeout = float(connect_timeout)
         self.request_timeout = float(request_timeout)
+        # mirror of the server's gateway_result_cap: how long a
+        # result/solve with timeout=None may legitimately block
+        self.result_cap = float(result_cap)
         self.reconnect_backoff = float(reconnect_backoff)
         self.reconnect_cap = float(reconnect_cap)
         self.max_reconnects = int(max_reconnects)
@@ -164,13 +174,20 @@ class Client:
         resp, _ = self._request(self._header("poll", handle=handle.id))
         return resp["result"]["state"]
 
+    def _wire_timeout(self, timeout):
+        """Socket wait for a blocking result exchange: the request's
+        own timeout + grace, or — with timeout=None, where the SERVER
+        decides when to answer (up to gateway_result_cap) — the
+        client's result_cap mirror + grace."""
+        cap = self.result_cap if timeout is None else float(timeout)
+        return cap + 10.0
+
     def result(self, handle, timeout=None):
         """Block for the structured result dict (arrays restored
         bit-exact from the npz payload).  The socket wait stretches to
-        `timeout` + grace so a slow solve isn't misread as a dead
-        peer."""
-        wire_timeout = None if timeout is None \
-            else float(timeout) + 10.0
+        `timeout` + grace (or `result_cap` + grace when timeout is
+        None) so a slow solve isn't misread as a dead peer."""
+        wire_timeout = self._wire_timeout(timeout)
         resp, payload = self._request(
             self._header("result", handle=handle.id, timeout=timeout),
             timeout=wire_timeout)
@@ -182,10 +199,8 @@ class Client:
             or f"net-{uuid.uuid4().hex}"
         hdr = self._header("solve", options=options, timeout=timeout,
                            idempotency_key=key, **kwargs)
-        wire_timeout = None if timeout is None \
-            else float(timeout) + 10.0
         resp, payload = self._request(hdr, P.encode_batch(batch),
-                                      timeout=wire_timeout)
+                                      timeout=self._wire_timeout(timeout))
         return P.decode_result(resp["result"], payload)
 
     def health(self):

@@ -12,7 +12,13 @@ all come for free.  The gateway's own job is exactly four things:
     (protocol.ERROR_CODES, one namespace for both layers);
   * **authentication** — `gateway_tokens` maps bearer token -> tenant
     id; with no table configured the gateway runs OPEN and every
-    caller is tenant "default" (tests, single-user dev loops);
+    caller is tenant "default" (tests, single-user dev loops).  Open
+    mode is LOOPBACK-ONLY: binding a non-loopback host without a token
+    table raises unless `gateway_open_non_loopback` is explicitly set.
+    The fleet-lifecycle verbs (`drain`/`roll`) are additionally gated
+    behind `gateway_admin_tokens` — a tenant bearer token must not be
+    able to drain admission or restart the fleet out from under the
+    other tenants;
   * **edge accounting** — `gateway.requests`, `gateway.rejects.<code>`,
     `gateway.bytes_in/out` counters and the
     `gateway.active_connections` gauge (telemetry.gateway_counters());
@@ -52,6 +58,11 @@ class Gateway:
 
     Options (all prefixed `gateway_` unless noted):
       gateway_tokens        {bearer token: tenant id} (None = open)
+      gateway_admin_tokens  bearer tokens allowed to drain/roll; when
+                            unset, drain/roll are open-mode-only (any
+                            authenticated deployment refuses them)
+      gateway_open_non_loopback  allow open mode (no token table) on
+                            a non-loopback bind (default False: raise)
       gateway_max_payload   per-frame payload cap bytes      (256 MiB)
       gateway_idle_timeout  close an idle connection after    (300 s)
       gateway_result_cap    hard cap on one result() wait     (600 s)
@@ -66,6 +77,14 @@ class Gateway:
         self.host = host
         self.port = int(port)
         self.tokens = o.get("gateway_tokens")      # None => open mode
+        admins = o.get("gateway_admin_tokens")
+        self.admin_tokens = None if admins is None else set(admins)
+        if self.tokens is None and not self._loopback(host) \
+                and not o.get("gateway_open_non_loopback"):
+            raise ValueError(
+                f"refusing open (unauthenticated) mode on non-loopback "
+                f"bind {host!r}: configure gateway_tokens, or set "
+                f"gateway_open_non_loopback=True to override")
         self.max_payload = int(o.get("gateway_max_payload",
                                      P.DEFAULT_MAX_PAYLOAD))
         self.idle_timeout = float(o.get("gateway_idle_timeout", 300.0))
@@ -86,6 +105,12 @@ class Gateway:
         self._active_connections = 0
         self.counts = {}               # plain-int mirror of counters
         self.rolls = 0
+
+    @staticmethod
+    def _loopback(host):
+        # NB: "" binds INADDR_ANY — emphatically not loopback
+        return host in ("localhost", "::1") \
+            or str(host).startswith("127.")
 
     # -- accounting helpers ------------------------------------------------
     def _count(self, name, n=1):
@@ -213,6 +238,11 @@ class Gateway:
                                  args=(conn, addr),
                                  name="serve-gateway-conn", daemon=True)
             with self._lock:
+                # prune finished handlers so a long-running gateway
+                # doesn't hold one Thread object per connection EVER
+                # accepted (and shutdown's join budget stays honest)
+                self._conn_threads = [
+                    c for c in self._conn_threads if c.is_alive()]
                 self._conn_threads.append(t)
             t.start()
 
@@ -228,9 +258,9 @@ class Gateway:
                 except P.ProtocolError as exc:
                     # a torn frame poisons the stream position: answer
                     # once, then close — the client reconnects clean
-                    self._reject(P.E_BAD_FRAME)
-                    self._safe_send(conn, self._error_frame(
-                        P.E_BAD_FRAME, str(exc)))
+                    # (_error_frame counts the reject — exactly once)
+                    self._safe_send(conn, P.pack_message(
+                        self._error_frame(P.E_BAD_FRAME, str(exc))))
                     return
                 except socket.timeout:
                     return             # idle connection reaped
@@ -277,10 +307,25 @@ class Gateway:
     def _authenticate(self, header):
         """Bearer token -> tenant id, or None when unauthorized.  With
         no token table the gateway is OPEN: every caller is tenant
-        "default" (the router's quotas then see one tenant)."""
+        "default" (the router's quotas then see one tenant).  An admin
+        token authenticates even without a tenant-table row (tenant
+        "admin") — operators don't need a quota bucket to drain."""
+        tok = header.get("token")
+        if self.admin_tokens is not None and tok in self.admin_tokens:
+            return (self.tokens or {}).get(tok, "admin")
         if self.tokens is None:
             return "default"
-        return self.tokens.get(header.get("token"))
+        return self.tokens.get(tok)
+
+    def _is_admin(self, header):
+        """May this caller drain/roll the fleet?  With an admin table:
+        only its tokens.  Without one: only open mode (dev loop) —
+        an authenticated multi-tenant deployment that configured no
+        admin tokens has NO wire path to drain/roll (operators hold
+        the Gateway object and call .drain()/.roll() directly)."""
+        if self.admin_tokens is not None:
+            return header.get("token") in self.admin_tokens
+        return self.tokens is None
 
     def _dispatch(self, header, payload):
         verb = header.get("verb")
@@ -397,9 +442,17 @@ class Gateway:
         return self._ok_frame("health", stats)
 
     def _verb_drain(self, header, payload, tenant):
+        if not self._is_admin(header):
+            return self._error_frame(
+                P.E_UNAUTHORIZED,
+                "drain requires a gateway_admin_tokens token"), b""
         out = self.drain(deadline=float(header.get("deadline", 5.0)))
         return self._ok_frame("drain", out)
 
     def _verb_roll(self, header, payload, tenant):
+        if not self._is_admin(header):
+            return self._error_frame(
+                P.E_UNAUTHORIZED,
+                "roll requires a gateway_admin_tokens token"), b""
         rolled = self.roll()
         return self._ok_frame("roll", {"rolled": rolled})

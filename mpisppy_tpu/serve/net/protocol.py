@@ -25,6 +25,14 @@ Error codes are the union of gateway-level frame/auth failures and the
 router's structured reject reasons (the gateway maps one onto the
 other — see ERROR_CODES and doc/src/serve.md's error-code matrix).
 
+Trust boundary: payload bytes come from the NETWORK, so — unlike the
+shard store, whose npz codec reads trusted on-disk data — this module
+NEVER unpickles them.  Every `np.load` here passes
+`allow_pickle=False`; the object-dtype fields a ScenarioBatch payload
+needs (name tuples, `model_meta`) travel as a JSON sidecar plus a
+pool of plain numeric arrays (`_meta_encode`/`_meta_decode`), so a
+crafted pickle inside a frame is a decode error, not code execution.
+
 Layering (AST + fresh-interpreter guarded in
 tests/test_net_gateway.py): this module never imports jax or mpmd at
 module level — batch (de)serialization reuses the shard store's
@@ -170,22 +178,112 @@ def write_message(sock, header, payload=b""):
 
 
 # -- ScenarioBatch payloads ------------------------------------------------
+#
+# The shard store's payload dict holds object-dtype arrays (the name
+# tuples, and model_meta — an arbitrary pytree of dicts/tuples/numpy
+# arrays).  Saved as-is those would need allow_pickle=True on load,
+# which at a network trust boundary means arbitrary code execution.
+# The wire codec therefore splits them: strings and structure go into
+# a JSON sidecar (stored as a uint8 array under _WIRE_JSON), numeric
+# leaves of model_meta go into the npz array pool under reserved
+# _WIRE_META_ARR keys, and decode reassembles with allow_pickle=False.
+
+_WIRE_JSON = "_wire_json"
+_WIRE_META_ARR = "_wire_meta_arr_"
+_NAME_FIELDS = ("tree_nonant_names", "tree_scen_names", "var_names")
+_TAG_ND = "__nd__"
+_TAG_TUPLE = "__tuple__"
+
+
+def _meta_encode(value, arrays):
+    """model_meta pytree -> JSON-safe tagged tree.  ndarrays move into
+    `arrays` under reserved npz keys (bit-exact); tuples are tagged so
+    decode restores tuple-ness (pytree structure survives).  Anything
+    not JSON/array-representable is a ProtocolError — the wire carries
+    data, never pickled code."""
+    if isinstance(value, np.ndarray):
+        key = f"{_WIRE_META_ARR}{len(arrays)}"
+        arrays[key] = value
+        return {_TAG_ND: key}
+    if isinstance(value, np.generic):
+        return value.item()
+    if isinstance(value, dict):
+        return {str(k): _meta_encode(v, arrays) for k, v in value.items()}
+    if isinstance(value, tuple):
+        return {_TAG_TUPLE: [_meta_encode(v, arrays) for v in value]}
+    if isinstance(value, list):
+        return [_meta_encode(v, arrays) for v in value]
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    raise ProtocolError(
+        f"model_meta value of type {type(value).__name__} is not "
+        f"wire-encodable (JSON scalars, lists, tuples, dicts and "
+        f"numpy arrays only)")
+
+
+def _meta_decode(node, z):
+    """Inverse of _meta_encode against the npz array pool `z`."""
+    if isinstance(node, dict):
+        if set(node) == {_TAG_ND}:
+            key = node[_TAG_ND]
+            if not (isinstance(key, str)
+                    and key.startswith(_WIRE_META_ARR)):
+                raise ProtocolError(f"bad meta array reference {key!r}")
+            return np.asarray(z[key])
+        if set(node) == {_TAG_TUPLE}:
+            return tuple(_meta_decode(v, z) for v in node[_TAG_TUPLE])
+        return {k: _meta_decode(v, z) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_meta_decode(v, z) for v in node]
+    return node
+
 
 def encode_batch(batch):
     """ScenarioBatch -> npz bytes, reusing the shard store's payload
     codec so the A representation (dense / shared / SplitA) survives
-    the wire exactly like it survives disk."""
+    the wire exactly like it survives disk — minus its object arrays,
+    which are re-encoded pickle-free (see the section comment)."""
     from ...streaming.store import _batch_payload
+    raw = _batch_payload(batch)
+    raw.pop("model_meta", None)        # re-encoded from batch below
+    arrays, names = {}, {}
+    for k, v in raw.items():
+        a = np.asarray(v)
+        if a.dtype == object:          # the *_names string tuples
+            names[k] = [str(s) for s in a.tolist()]
+        else:
+            arrays[k] = a
+    side = {"names": names}
+    if batch.model_meta is not None:
+        side["model_meta"] = _meta_encode(batch.model_meta, arrays)
+    arrays[_WIRE_JSON] = np.frombuffer(
+        json.dumps(side).encode("utf-8"), dtype=np.uint8)
     buf = io.BytesIO()
-    np.savez_compressed(buf, **_batch_payload(batch))
+    np.savez_compressed(buf, **arrays)
     return buf.getvalue()
 
 
 def decode_batch(data):
-    """npz bytes -> ScenarioBatch (inverse of encode_batch)."""
+    """npz bytes -> ScenarioBatch (inverse of encode_batch).  Network
+    bytes: `allow_pickle=False`, so a crafted object array raises
+    instead of executing."""
     from ...streaming.store import _batch_from_payload
-    return _batch_from_payload(np.load(io.BytesIO(data),
-                                       allow_pickle=True))
+    z = np.load(io.BytesIO(data), allow_pickle=False)
+    payload = {k: np.asarray(z[k]) for k in z.files
+               if k != _WIRE_JSON and not k.startswith(_WIRE_META_ARR)}
+    if _WIRE_JSON not in z.files:
+        raise ProtocolError("batch payload missing wire sidecar")
+    side = json.loads(
+        np.asarray(z[_WIRE_JSON]).tobytes().decode("utf-8"))
+    for k, v in (side.get("names") or {}).items():
+        if k not in _NAME_FIELDS:
+            raise ProtocolError(f"unexpected sidecar name field {k!r}")
+        payload[k] = np.array([str(s) for s in v], dtype=object)
+    if "model_meta" in side:
+        meta = np.empty(1, dtype=object)
+        meta[0] = _meta_decode(side["model_meta"], z)
+        payload["model_meta"] = meta
+    return _batch_from_payload(payload)
 
 
 # -- result dicts ----------------------------------------------------------
@@ -214,6 +312,10 @@ def encode_result(res):
     scalars, arrays = {}, {}
     for k, v in dict(res).items():
         if isinstance(v, np.ndarray):
+            if v.dtype == object:      # would need pickle on the wire
+                raise TypeError(
+                    f"result field {k!r} is an object-dtype array; "
+                    f"only numeric/string arrays are wire-encodable")
             arrays[k] = v
         else:
             scalars[k] = jsonable(v)
@@ -227,11 +329,13 @@ def encode_result(res):
 
 
 def decode_result(header_result, payload):
-    """Inverse of encode_result."""
+    """Inverse of encode_result.  Network bytes: `allow_pickle=False`
+    (a malicious or confused peer gets a decode error, not code
+    execution in the client)."""
     res = dict(header_result)
     keys = res.pop("_array_keys", [])
     if keys:
-        z = np.load(io.BytesIO(payload), allow_pickle=True)
+        z = np.load(io.BytesIO(payload), allow_pickle=False)
         for k in keys:
             res[k] = np.asarray(z[k])
     return res

@@ -168,6 +168,64 @@ def test_result_codec_is_bitwise():
     assert out["status"] == "ok" and out["reason"] is None
 
 
+def test_decode_batch_never_unpickles_hostile_payload(tmp_path):
+    """REVIEW fix (high): the wire codec must never unpickle
+    network-supplied bytes.  A crafted object array whose __reduce__
+    has a side effect is a decode ERROR (allow_pickle=False), and the
+    side effect never fires — at the protocol layer AND through a live
+    gateway (mapped to bad_payload, connection stays usable)."""
+    import io as _io
+    import os as _os
+    marker = tmp_path / "pwned"
+
+    class Evil:
+        def __reduce__(self):
+            return (_os.mkdir, (str(marker),))
+
+    buf = _io.BytesIO()
+    np.savez(buf, c=np.array([Evil()], dtype=object))
+    hostile = buf.getvalue()
+    with pytest.raises(Exception):
+        P.decode_batch(hostile)
+    assert not marker.exists(), "pickle executed during decode!"
+
+    gw = _gateway()
+    try:
+        with socket.create_connection(gw.address, timeout=5) as s:
+            s.settimeout(10.0)
+            P.write_message(s, {"kind": "request", "verb": "submit",
+                                "token": ""}, hostile)
+            hdr, _ = P.read_message(s)
+            assert hdr["ok"] is False
+            assert hdr["error_code"] == P.E_BAD_PAYLOAD
+            # decode failed structurally; nothing executed
+            assert not marker.exists()
+            # the frame itself was well-formed: stream stays usable
+            P.write_message(s, {"kind": "request", "verb": "health",
+                                "token": ""})
+            hdr, _ = P.read_message(s)
+            assert hdr["ok"] is True
+    finally:
+        gw.shutdown()
+
+
+def test_batch_codec_output_is_pickle_free():
+    """Every array in an encoded batch payload loads under
+    allow_pickle=False — including farmer's model_meta, whose tuple of
+    index arrays rides the tagged-JSON sidecar, not a pickle."""
+    import io as _io
+    data = P.encode_batch(farmer.build_batch(3))
+    z = np.load(_io.BytesIO(data), allow_pickle=False)
+    for k in z.files:
+        np.asarray(z[k])               # raises if pickle were needed
+
+
+def test_encode_result_refuses_object_arrays():
+    with pytest.raises(TypeError, match="object-dtype"):
+        P.encode_result({"status": "ok",
+                         "bad": np.array([{"a": 1}], dtype=object)})
+
+
 def test_error_code_matrix_covers_protocol_and_router():
     for code in (P.E_BAD_FRAME, P.E_BAD_VERB, P.E_UNAUTHORIZED,
                  P.E_UNKNOWN_HANDLE, P.E_DRAINING, "over_quota",
@@ -301,6 +359,94 @@ def test_gateway_drain_rejects_new_admission():
         gw.shutdown()
 
 
+def test_gateway_open_mode_requires_loopback():
+    """REVIEW fix: open (unauthenticated) mode + a non-loopback bind
+    would hand every LAN peer tenant "default" — refused at
+    construction unless explicitly overridden or authenticated."""
+    with pytest.raises(ValueError, match="non-loopback"):
+        Gateway(dict(GW_OPTS), host="0.0.0.0")
+    # authenticated, or explicitly overridden: constructible
+    Gateway({**GW_OPTS, "gateway_tokens": {"t": "a"}}, host="0.0.0.0")
+    Gateway({**GW_OPTS, "gateway_open_non_loopback": True},
+            host="0.0.0.0")
+    Gateway(dict(GW_OPTS), host="127.0.0.1")   # loopback: fine open
+
+
+def test_gateway_admin_tokens_gate_drain_and_roll():
+    """REVIEW fix: drain/roll are fleet-lifecycle verbs — a tenant
+    bearer token must not drain admission or restart the fleet.  With
+    gateway_admin_tokens set, only those tokens pass; a configured
+    deployment WITHOUT an admin table refuses the verbs entirely."""
+    gw = _gateway({"gateway_tokens": {"sesame": "tenant-a"},
+                   "gateway_admin_tokens": ["root-tok"]})
+    try:
+        with Client(*gw.address, token="sesame") as c:
+            for call in (lambda: c.drain(deadline=0.1),
+                         lambda: c.roll(timeout=10)):
+                with pytest.raises(ClientError) as exc:
+                    call()
+                assert exc.value.code == P.E_UNAUTHORIZED
+        assert gw.counts.get("drains", 0) == 0
+        assert gw.rolls == 0
+        with Client(*gw.address, token="root-tok") as c:
+            assert c.drain(deadline=0.1)["drained_open"] == 0
+        assert gw.counts["drains"] == 1
+    finally:
+        gw.shutdown()
+    # authenticated mode with NO admin table: no wire path to drain
+    gw = _gateway({"gateway_tokens": {"sesame": "tenant-a"}})
+    try:
+        with Client(*gw.address, token="sesame") as c:
+            with pytest.raises(ClientError) as exc:
+                c.drain(deadline=0.1)
+            assert exc.value.code == P.E_UNAUTHORIZED
+    finally:
+        gw.shutdown()
+
+
+def test_gateway_bad_frame_counted_once_and_answered():
+    """REVIEW fix: a torn frame is answered with ONE well-formed
+    bad_frame error frame (packed, not a raw dict) and counted exactly
+    once before the gateway closes the poisoned stream."""
+    gw = _gateway()
+    try:
+        with socket.create_connection(gw.address, timeout=5) as s:
+            s.settimeout(5.0)
+            # exactly magic+len sized so the server consumes it all
+            # (no unread bytes -> clean FIN, not RST, on close)
+            s.sendall(b"GARBAGE!" + b"\x00" * 4)
+            hdr, _ = P.read_message(s)
+            assert hdr["ok"] is False
+            assert hdr["error_code"] == P.E_BAD_FRAME
+            assert P.read_message(s) == (None, None)   # then closed
+        assert gw.counts["rejects_by_code"][P.E_BAD_FRAME] == 1
+    finally:
+        gw.shutdown()
+
+
+def test_gateway_conn_threads_pruned():
+    """REVIEW fix: finished connection handlers are pruned from the
+    tracking list, so the gateway doesn't grow one Thread object per
+    connection ever accepted."""
+    gw = _gateway()
+    try:
+        for _ in range(5):
+            with Client(*gw.address) as c:
+                c.health()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with Client(*gw.address) as c:
+                c.health()
+            with gw._lock:
+                n = len(gw._conn_threads)
+            if n <= 2:
+                break
+            time.sleep(0.05)
+        assert n <= 2, f"{n} connection threads still tracked"
+    finally:
+        gw.shutdown()
+
+
 def test_gateway_counters_stable_keys(fresh_telemetry):
     """telemetry.gateway_counters() mirrors router_counters(): stable
     keys with telemetry off (zeros) and real values with it on."""
@@ -356,6 +502,29 @@ def test_client_reconnects_with_capped_jitter_backoff():
     with pytest.raises(ConnectionError):
         dead.health()
     assert time.monotonic() - t0 < 30.0
+
+
+@pytest.mark.chaos
+def test_client_timeout_none_survives_slow_solve():
+    """REVIEW fix: with timeout=None the SERVER decides when to answer
+    (up to gateway_result_cap), so the client stretches its socket
+    wait to result_cap + grace.  A solve slower than request_timeout
+    must complete on the ORIGINAL connection — not trip
+    socket.timeout, tear the stream, and burn the reconnect budget on
+    a healthy request (stranding gateway threads on dead sockets)."""
+    gw = _gateway({"chaos": {"slow_replica": 1.0}})
+    try:
+        with Client(*gw.address, request_timeout=0.3, result_cap=60.0,
+                    max_reconnects=2) as c:
+            t0 = time.monotonic()
+            res = c.solve(farmer.build_batch(3), FAST_OPTS,
+                          model="farmer")          # timeout=None
+            assert res["status"] == "ok"
+            assert time.monotonic() - t0 > 0.3     # outlived the old cap
+            assert c.reconnects == 0, \
+                "slow solve misread as transport failure"
+    finally:
+        gw.shutdown()
 
 
 # -- e2e over a real socket ------------------------------------------------
