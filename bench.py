@@ -535,15 +535,29 @@ def _serve_chaos_row(opts, S, dtype):
 
 
 def worker_serve():
-    """BENCH_MODEL=serve: SolverService throughput on concurrent
-    same-bucket farmer requests (mpisppy_tpu/serve/) — the serving
-    shape the ROADMAP north star needs numbers for.  Emits
-    `serve_throughput_req_per_sec` and `compile_cache_hit_rate`
-    alongside the standard metric fields; there is no reference
-    comparator, so vs_baseline is 0.  Unless BENCH_SERVE_CHAOS=0, a
-    second chaos-on phase runs the replica-set Router under injected
-    replica_crash/slow_replica/poison_request and merges its
-    latency-percentile and resilience counters into the same row."""
+    """BENCH_MODEL=serve: replica-fleet throughput A/B, thread mode vs
+    process mode (mpisppy_tpu/serve/procpool.py) on the same host and
+    workload — concurrent same-bucket farmer requests through a Router
+    with BENCH_SERVE_REPLICAS slots.  Thread replicas serialize device
+    execution on the in-process `_BACKEND_LOCK`; process replicas each
+    own a JAX runtime, so the fleet actually parallelizes — the
+    headline `serve_throughput_req_per_sec` is the PROCESS-mode number
+    and `vs_baseline`/`speedup_process_vs_thread` is the ratio over
+    thread mode.  Both modes share one AOT artifact dir
+    (MPISPPY_TPU_COMPILE_CACHE_DIR): the thread run populates it, the
+    process workers `prewarm()` from it at boot — `proc_boot_seconds`
+    and `aot_prewarm_hits` report that economics.  Each mode runs the
+    full workload once untimed (warmup: compiles + AOT persistence
+    excluded, same rule as the other workers), then once timed.
+    The parallel win scales with `host_cpus`: process workers need
+    cores to land on, so on a 1-core host both modes serialize on the
+    one core and the ratio reflects only the wire overhead (~0.9-1.0);
+    on an N-core host it approaches min(N, replicas).
+    Unless BENCH_SERVE_CHAOS=0, a chaos-on phase runs the thread-mode
+    Router under injected replica_crash/slow_replica/poison_request
+    and merges its resilience counters into the same row."""
+    import tempfile
+
     import numpy as np
 
     from mpisppy_tpu.utils.platform import (enable_f64_if_cpu,
@@ -552,45 +566,104 @@ def worker_serve():
 
     from mpisppy_tpu import telemetry
     from mpisppy_tpu.models import farmer
-    from mpisppy_tpu.serve.service import SolverService
+    from mpisppy_tpu.serve.router import Router
 
     on_tpu = not enable_f64_if_cpu()
     S = int(os.environ.get("BENCH_SCENS", 3))
     n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", 16))
     max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", 8))
-    opts = {"defaultPHrho": 1.0, "PHIterLimit": 50, "convthresh": 1e-4,
-            "pdhg_eps": 1e-6}
+    n_rep = int(os.environ.get("BENCH_SERVE_REPLICAS", 2))
+    # convthresh 0 runs every request through the full PH schedule —
+    # uniform, device-bound per-group cost, so the A/B measures
+    # execution parallelism instead of early-convergence noise
+    iters = int(os.environ.get("BENCH_SERVE_PH_ITERS", 200))
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": iters,
+            "convthresh": 0.0, "pdhg_eps": 1e-6}
+    chaos_opts = {"defaultPHrho": 1.0, "PHIterLimit": 50,
+                  "convthresh": 1e-4, "pdhg_eps": 1e-6}
     dtype = np.float32 if on_tpu else np.float64
-    svc = SolverService({"serve_max_inflight": n_req + 4,
-                         "serve_max_batch": max_batch,
-                         "telemetry": True}).start()
-    # warmup request: compiles excluded, same rule as the other workers
-    svc.solve(farmer.build_batch(S, dtype=dtype), opts, model="farmer")
+    aot_dir = tempfile.mkdtemp(prefix="bench_serve_aot_")
+    prev_cache_dir = os.environ.get("MPISPPY_TPU_COMPILE_CACHE_DIR")
+    os.environ["MPISPPY_TPU_COMPILE_CACHE_DIR"] = aot_dir
+
     batches = [farmer.build_batch(S, seedoffset=i, dtype=dtype)
                for i in range(n_req)]
-    t0 = time.time()
-    handles = [svc.submit(b, opts, model="farmer") for b in batches]
-    results = [svc.result(h) for h in handles]
-    wall = time.time() - t0
-    ok = sum(r["status"] == "ok" for r in results)
-    st = svc.cache.stats()
-    hit_rate = st["hits"] / max(st["hits"] + st["misses"], 1)
+
+    def run_mode(mode):
+        router = Router({
+            "serve_replicas": n_rep, "serve_replica_mode": mode,
+            "serve_max_batch": max_batch,
+            "serve_max_inflight": n_req + 8,
+            # same batch-forming window in BOTH modes: without it,
+            # wire submits trickle into the worker and dispatch as
+            # odd-width groups, each width a fresh trace
+            "serve_coalesce_window_s": 0.25,
+            "router_hedge_threshold": None,
+            "telemetry": True}).start()
+        try:
+            # untimed pass: trace/AOT-load every width this workload
+            # hits, on every replica, so the timed pass is steady-state
+            warm = [router.submit(b, opts, model="farmer",
+                                  idempotency_key=f"warm-{mode}-{i}")
+                    for i, b in enumerate(batches)]
+            for h in warm:
+                router.result(h, timeout=600)
+            t0 = time.time()
+            handles = [router.submit(b, opts, model="farmer",
+                                     idempotency_key=f"run-{mode}-{i}")
+                       for i, b in enumerate(batches)]
+            results = [router.result(h, timeout=600) for h in handles]
+            wall = time.time() - t0
+            ok = sum(r["status"] == "ok" for r in results)
+            return wall, ok, router.stats()
+        finally:
+            router.shutdown(timeout=30)
+
+    try:
+        wall_thr, ok_thr, st_thr = run_mode("thread")
+        wall_proc, ok_proc, st_proc = run_mode("process")
+    finally:
+        if prev_cache_dir is None:
+            os.environ.pop("MPISPPY_TPU_COMPILE_CACHE_DIR", None)
+        else:
+            os.environ["MPISPPY_TPU_COMPILE_CACHE_DIR"] = prev_cache_dir
+    tput_thr = n_req / wall_thr
+    tput_proc = n_req / wall_proc
+    speedup = tput_proc / tput_thr
+    cc_proc = st_proc["compile_cache"]
+    hit_rate = st_thr["compile_cache"]["hits"] / max(
+        st_thr["compile_cache"]["hits"]
+        + st_thr["compile_cache"]["misses"], 1)
+    boots = st_proc.get("proc_boot_seconds") or [0.0]
     counters = telemetry.serve_counters()
-    svc.shutdown()
     out = {
-        "metric": "serve_farmer_throughput_req_per_sec",
-        "value": round(n_req / wall, 3) if ok == n_req else -1,
-        "unit": "req/s", "vs_baseline": 0,
-        "serve_throughput_req_per_sec": round(n_req / wall, 3),
+        "metric": "serve_throughput_req_per_sec",
+        "value": round(tput_proc, 3) if ok_proc == n_req else -1,
+        "unit": "req/s", "vs_baseline": round(speedup, 3),
+        "serve_throughput_req_per_sec": round(tput_proc, 3),
+        "serve_throughput_req_per_sec_thread": round(tput_thr, 3),
+        "speedup_process_vs_thread": round(speedup, 3),
+        "replica_mode": "process", "replicas": n_rep,
+        "proc_boot_seconds": round(max(boots), 3),
+        "aot_prewarm_hits": int(cc_proc.get("aot_prewarm_hits", 0)),
+        "proc_prewarm_loaded": int(st_proc.get("prewarm_loaded", 0)),
         "compile_cache_hit_rate": round(hit_rate, 4),
-        "requests": n_req, "ok": ok, "wall_s": round(wall, 3),
+        "requests": n_req, "ok": ok_proc, "ok_thread": ok_thr,
+        "wall_s": round(wall_proc, 3),
+        "wall_s_thread": round(wall_thr, 3),
         "max_batch": max_batch, "scens": S,
         "device": ("TPU" if on_tpu else "cpu"),
+        # the parallel win needs cores for the workers to land on: on
+        # a 1-core host the A/B degenerates to serialized compute plus
+        # wire overhead, and speedup_process_vs_thread sits near (or
+        # below) 1.0 — read it against this field
+        "host_cpus": len(os.sched_getaffinity(0)),
         **counters}
-    if ok != n_req:
-        out["note"] = f"{n_req - ok} request(s) not ok"
+    if ok_proc != n_req or ok_thr != n_req:
+        out["note"] = (f"{n_req - ok_proc} process / "
+                       f"{n_req - ok_thr} thread request(s) not ok")
     if os.environ.get("BENCH_SERVE_CHAOS", "1") != "0":
-        out.update(_serve_chaos_row(opts, S, dtype))
+        out.update(_serve_chaos_row(chaos_opts, S, dtype))
     print(json.dumps(out))
 
 

@@ -16,6 +16,12 @@ Modules (doc/src/serve.md is the operator-facing chapter):
   * `replica` — Replica/ReplicaSet: N supervised services as isolated
     fault domains (own threads, own compile-cache handle, separately
     drainable), with slot-targeted chaos and replace-and-warm_from;
+  * `procpool`/`procworker` — ProcReplica/ProcReplicaSet: the same
+    replica surface backed by one OS process per slot
+    (`serve_replica_mode="process"`), talking the serve/net wire
+    protocol over loopback — device execution parallelizes past the
+    in-process `_BACKEND_LOCK`, and workers boot warm by prewarming
+    the shared AOT artifact dir;
   * `router` — the replica-set front door: health-probed circuit
     breakers, hedged retries made safe by idempotency keys, per-tenant
     token-bucket quotas, a brownout ladder, and replace-and-replay of
@@ -35,9 +41,10 @@ from .api import (RequestHandle, RouterHandle, get_service,  # noqa: F401
 
 __all__ = [
     "RequestHandle", "RouterHandle", "SolverService", "CompileCache",
-    "bucket_key", "Router", "Replica", "ReplicaSet", "CircuitBreaker",
-    "TokenBucket", "get_service", "poll", "result", "shutdown_service",
-    "solve", "start_service", "submit",
+    "bucket_key", "Router", "Replica", "ReplicaSet", "ProcReplica",
+    "ProcReplicaSet", "CircuitBreaker", "TokenBucket", "get_service",
+    "poll", "result", "shutdown_service", "solve", "start_service",
+    "submit",
 ]
 
 
@@ -58,4 +65,7 @@ def __getattr__(name):
     if name in ("Replica", "ReplicaSet"):
         from . import replica as _replica
         return getattr(_replica, name)
+    if name in ("ProcReplica", "ProcReplicaSet"):
+        from . import procpool as _procpool
+        return getattr(_procpool, name)
     raise AttributeError(name)

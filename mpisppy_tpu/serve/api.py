@@ -6,7 +6,11 @@ circuit breakers, hedged retries, tenant quotas, and brownout
 degradation all live behind these same five calls.  By default the
 router runs ONE replica (`serve_replicas=1`), which behaves exactly
 like the old direct-SolverService wiring; pass `serve_replicas >= 2`
-in options to get real fault isolation.
+in options to get real fault isolation.  Replicas are in-process
+threads by default; `serve_replica_mode="process"` backs each slot
+with its own OS process (serve/procpool.py) so device execution
+parallelizes past the in-process `_BACKEND_LOCK` — same five calls,
+same results (batch=1 stays bitwise-equal to `PH.ph_main`).
 
 IMPORT CONTRACT: importing this module touches neither jax nor the
 service machinery — clients embed it for free (AST-guarded in

@@ -60,6 +60,7 @@ import json
 import os
 import struct
 import threading
+import time
 import zlib
 
 from .. import global_toc
@@ -194,6 +195,106 @@ def _aot_decode(data, fingerprint):
     return payload
 
 
+# -- boot-time prewarm + artifact lifecycle --------------------------------
+#
+# A process replica boots, calls `prewarm()`, and every artifact in the
+# shared aot/ dir is deserialized ONCE into this fingerprint-keyed
+# resident set; `_aot_load` consults it before touching the disk, so
+# the first request of every previously-seen (bucket, width) runs warm
+# without a per-request open+deserialize.  The registry is process-
+# global on purpose — the artifacts are keyed by full fingerprint, so a
+# stale entry can never satisfy a lookup it shouldn't.
+
+_PREWARM_LOCK = threading.Lock()
+_PREWARMED = {}                        # fingerprint -> jax.export.Exported
+
+
+def prewarm(directory=None):
+    """Load the full AOT artifact set into the resident prewarm
+    registry.  `directory` defaults to `aot_cache_dir()` (None → no-op,
+    returns 0).  Undecodable/foreign files are skipped and counted in
+    `cache.aot_load_failures`.  Returns the number of artifacts
+    resident after the sweep."""
+    from jax import export as jax_export
+    d = directory if directory is not None else aot_cache_dir()
+    if not d or not os.path.isdir(d):
+        return 0
+    tel = _telemetry.get()
+    for fname in sorted(os.listdir(d)):
+        if not fname.endswith(_AOT_SUFFIX):
+            continue
+        fp = fname[:-len(_AOT_SUFFIX)]
+        with _PREWARM_LOCK:
+            if fp in _PREWARMED:
+                continue
+        try:
+            with open(os.path.join(d, fname), "rb") as f:
+                payload = _aot_decode(f.read(), fp)
+            exported = jax_export.deserialize(payload)
+        except Exception as exc:
+            tel.counter("cache.aot_load_failures").inc()
+            global_toc(f"WARNING: prewarm rejected {fname}: {exc}")
+            continue
+        with _PREWARM_LOCK:
+            _PREWARMED[fp] = exported
+    with _PREWARM_LOCK:
+        return len(_PREWARMED)
+
+
+def clear_prewarmed():
+    """Drop the resident prewarm registry (tests)."""
+    with _PREWARM_LOCK:
+        _PREWARMED.clear()
+
+
+def prune_aot_dir(max_age_s=None, max_total_bytes=None, directory=None):
+    """Bound the on-disk aot/ artifact set: evict entries older than
+    `max_age_s` (by mtime), then oldest-first until the directory is
+    under `max_total_bytes`.  Both limits None → no-op.  Evictions
+    count in `cache.aot_evictions`; returns the number removed.
+    Concurrent writers are fine — a racing delete is just skipped."""
+    d = directory if directory is not None else aot_cache_dir()
+    if not d or not os.path.isdir(d):
+        return 0
+    if max_age_s is None and max_total_bytes is None:
+        return 0
+    entries = []
+    for fname in os.listdir(d):
+        if not fname.endswith(_AOT_SUFFIX):
+            continue
+        path = os.path.join(d, fname)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        entries.append((st.st_mtime, st.st_size, path))
+    entries.sort()                      # oldest first
+    now = time.time()
+    doomed = []
+    if max_age_s is not None:
+        cutoff = now - float(max_age_s)
+        doomed = [e for e in entries if e[0] < cutoff]
+        entries = [e for e in entries if e[0] >= cutoff]
+    if max_total_bytes is not None:
+        total = sum(e[1] for e in entries)
+        while entries and total > int(max_total_bytes):
+            e = entries.pop(0)
+            doomed.append(e)
+            total -= e[1]
+    tel = _telemetry.get()
+    removed = 0
+    for _, _, path in doomed:
+        try:
+            os.remove(path)
+        except OSError:
+            continue
+        removed += 1
+        tel.counter("cache.aot_evictions").inc()
+    if removed:
+        global_toc(f"AOT cache pruned: {removed} artifact(s) evicted")
+    return removed
+
+
 class _BatchedRunner:
     """One batch width's executable: flat leaves through the exported
     artifact, pytree structure restored at the edges.  Callable exactly
@@ -243,8 +344,15 @@ class CompiledBucket:
     def _aot_load(self, path, fingerprint):
         """Deserialize a persisted executable, or None (counted) when
         the file is absent, torn, corrupt, or fingerprint-skewed —
-        the silent-fallback half of the AOT contract."""
+        the silent-fallback half of the AOT contract.  A boot-time
+        `prewarm()` hit short-circuits the disk entirely."""
         from jax import export as jax_export
+        with _PREWARM_LOCK:
+            exported = _PREWARMED.get(fingerprint)
+        if exported is not None:
+            self._aot_account("aot_prewarm_hits")
+            self._aot_account("aot_loads")
+            return exported
         if not os.path.exists(path):
             return None
         try:
@@ -354,6 +462,7 @@ class CompileCache:
         self.aot_load_failures = 0
         self.aot_saves = 0
         self.aot_export_failures = 0
+        self.aot_prewarm_hits = 0
 
     def get(self, batch, options=None, model=None):
         """The CompiledBucket for one request (building it on first
@@ -379,7 +488,28 @@ class CompileCache:
                     "aot_loads": self.aot_loads,
                     "aot_load_failures": self.aot_load_failures,
                     "aot_saves": self.aot_saves,
-                    "aot_export_failures": self.aot_export_failures}
+                    "aot_export_failures": self.aot_export_failures,
+                    "aot_prewarm_hits": self.aot_prewarm_hits}
+
+
+_MERGE_KEYS = ("hits", "misses", "buckets", "aot_loads",
+               "aot_load_failures", "aot_saves",
+               "aot_export_failures", "aot_prewarm_hits")
+
+
+def merged_stats_dicts(stat_dicts):
+    """Aggregate already-materialized `CompileCache.stats()` dicts —
+    the form process replicas report over the wire (the cache object
+    lives in the worker process; only its stats cross the socket)."""
+    out = {k: 0 for k in _MERGE_KEYS}
+    out["caches"] = 0
+    for s in stat_dicts:
+        if not s:
+            continue
+        for k in _MERGE_KEYS:
+            out[k] += int(s.get(k, 0))
+        out["caches"] += 1
+    return out
 
 
 def merged_stats(caches):
@@ -391,14 +521,4 @@ def merged_stats(caches):
     (which the AOT disk layer now refunds: the second replica LOADS
     what the first traced), and the signal this aggregate exists to
     expose."""
-    out = {"hits": 0, "misses": 0, "buckets": 0, "caches": 0,
-           "aot_loads": 0, "aot_load_failures": 0, "aot_saves": 0,
-           "aot_export_failures": 0}
-    for c in caches:
-        s = c.stats()
-        for k in ("hits", "misses", "buckets", "aot_loads",
-                  "aot_load_failures", "aot_saves",
-                  "aot_export_failures"):
-            out[k] += s.get(k, 0)
-        out["caches"] += 1
-    return out
+    return merged_stats_dicts(c.stats() for c in caches)

@@ -269,6 +269,11 @@ class Gateway:
                 self._count("requests")
                 resp_header, resp_payload = self._dispatch(
                     header, payload)
+                if "seq" in header:
+                    # pipelined clients (net/client.PooledClient) stamp
+                    # a per-connection sequence number; echoing it lets
+                    # them cross-check FIFO response matching
+                    resp_header["seq"] = header["seq"]
                 n = self._safe_send(
                     conn, P.pack_message(resp_header, resp_payload))
                 self._count("bytes_out", n)
@@ -329,7 +334,10 @@ class Gateway:
 
     def _dispatch(self, header, payload):
         verb = header.get("verb")
-        if verb not in P.VERBS:
+        if verb not in P.VERBS or not hasattr(self, f"_verb_{verb}"):
+            # the second clause: protocol.VERBS also names replica-
+            # worker verbs (peek/warm_from/shutdown) the gateway does
+            # not serve — a structured reject, not E_INTERNAL
             return self._error_frame(P.E_BAD_VERB,
                                      f"unknown verb {verb!r}"), b""
         tenant = self._authenticate(header)

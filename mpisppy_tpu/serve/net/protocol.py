@@ -56,7 +56,10 @@ PROTO_FORMAT = 1
 MAX_HEADER_BYTES = 1 << 20          # 1 MiB of JSON is already absurd
 DEFAULT_MAX_PAYLOAD = 1 << 28       # 256 MiB per frame
 
-VERBS = ("submit", "poll", "result", "solve", "health", "drain", "roll")
+VERBS = ("submit", "poll", "result", "solve", "health", "drain", "roll",
+         # replica-worker verbs (serve/procworker.py): the gateway
+         # rejects these with E_BAD_VERB — it has no handlers for them
+         "peek", "peek_many", "statuses", "warm_from", "shutdown")
 
 # -- error-code matrix (doc/src/serve.md) ----------------------------------
 # gateway-level codes: the request never reached the router
@@ -259,7 +262,10 @@ def encode_batch(batch):
     arrays[_WIRE_JSON] = np.frombuffer(
         json.dumps(side).encode("utf-8"), dtype=np.uint8)
     buf = io.BytesIO()
-    np.savez_compressed(buf, **arrays)
+    # uncompressed on purpose: payloads are a few KiB and zlib costs
+    # ~40% of the encode on the submit path, which a process-replica
+    # parent pays once per request on the loopback wire
+    np.savez(buf, **arrays)
     return buf.getvalue()
 
 
@@ -322,7 +328,9 @@ def encode_result(res):
     payload = b""
     if arrays:
         buf = io.BytesIO()
-        np.savez_compressed(buf, **arrays)
+        # uncompressed for the same reason as encode_batch: the codec
+        # CPU, not the byte count, is what the wire path pays for
+        np.savez(buf, **arrays)
         payload = buf.getvalue()
     scalars["_array_keys"] = sorted(arrays)
     return scalars, payload

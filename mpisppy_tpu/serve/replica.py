@@ -81,6 +81,13 @@ class Replica:
     def health(self):
         return self.service.health()
 
+    def cache_stats(self):
+        """This replica's CompileCache.stats() dict — the duck-typed
+        surface Router.stats() merges (process replicas report the same
+        dict over the wire, so the router never touches a cache
+        object)."""
+        return self.service.cache.stats()
+
     @property
     def failed(self):
         return self.service._failed is not None

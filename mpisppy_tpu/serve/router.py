@@ -211,7 +211,13 @@ class Router:
       router_brownout_conv_factor level-2 convthresh widening      (10)
       router_brownout_min_priority level-3 admission floor         (1)
       router_checkpoint_dir       drain checkpoint dir         (tmpdir)
-    plus every serve_* key, forwarded to each replica's service."""
+      serve_replica_mode          "thread" | "process"        ("thread")
+    plus every serve_* key, forwarded to each replica's service.
+    In "process" mode each slot is its own OS process
+    (serve/procpool.py) — device execution parallelizes past the
+    in-process `_BACKEND_LOCK`; everything above the replica surface
+    (breakers, hedging, quotas, brownout, replace-and-replay, roll)
+    is mode-blind."""
 
     def __init__(self, options=None, replica_set=None):
         o = dict(options or {})
@@ -247,8 +253,17 @@ class Router:
         self._workdir = o.get("router_checkpoint_dir")
         self._tel = _telemetry.configure_from_options(o.get("telemetry"))
         if replica_set is None:
-            from .replica import ReplicaSet
-            replica_set = ReplicaSet(o)
+            mode = o.get("serve_replica_mode", "thread")
+            if mode == "process":
+                from .procpool import ProcReplicaSet
+                replica_set = ProcReplicaSet(o)
+            elif mode == "thread":
+                from .replica import ReplicaSet
+                replica_set = ReplicaSet(o)
+            else:
+                raise ValueError(
+                    "serve_replica_mode must be 'thread' or "
+                    f"'process', got {mode!r}")
         self.replica_set = replica_set
         self.breakers = [
             CircuitBreaker(self.breaker_failures, self.breaker_backoff,
@@ -269,6 +284,8 @@ class Router:
         self._idempotency = {}         # key -> rid
         self._buckets = {}             # tenant -> TokenBucket
         self._suspects_seen = {}       # replica name -> counted ids
+        self._starvation_seen = {}     # replica name -> counted total
+        self._rr_offset = 0            # rotates equal-load pick ties
         self.counts = {}               # plain-int mirror of counters
         self.latencies = []            # ok-result router wall seconds
         self._monitor = None
@@ -369,9 +386,15 @@ class Router:
                 self._resolve_locked(
                     rreq, rejected_result(rid, reason))
                 return RouterHandle(rid)
-            self._open[rid] = rreq
             self._count("requests_submitted")
+        # route BEFORE exposing the request to the monitor's scan: a
+        # wire submit takes milliseconds, and a scan tick landing in
+        # that window would see an empty handle list and "replay" a
+        # request that was never routed — a duplicate execution
         self._route(rreq)
+        with self._lock:
+            if not rreq.done.is_set():
+                self._open[rid] = rreq
         return RouterHandle(rid)
 
     def poll(self, handle):
@@ -441,10 +464,20 @@ class Router:
     def _pick_slot(self, exclude=()):
         """Deadline-aware least-loaded routing over allowed slots:
         breakers gate admission per slot, then the shallowest
-        queue+inflight wins (the request waits the least there)."""
+        queue+inflight wins (the request waits the least there).
+        Equal loads round-robin via a rotating scan offset — a fixed
+        tie-break would dump every burst on slot 0, and uneven splits
+        dispatch odd-width groups downstream (each width its own
+        trace)."""
         now = time.monotonic()
+        n = len(self.replica_set)
+        with self._lock:
+            off = self._rr_offset
+            self._rr_offset = (off + 1) % max(n, 1)
         best, best_load = None, None
-        for slot, replica in enumerate(self.replica_set):
+        for i in range(n):
+            slot = (off + i) % n
+            replica = self.replica_set[slot]
             if slot in exclude or replica.condemned or replica.failed:
                 continue
             if not self.breakers[slot].allow(now):
@@ -539,6 +572,7 @@ class Router:
                 continue
             h = replica.health()
             self._attribute_crashes(replica, h["crash_suspects"])
+            self._note_starvation(replica, h)
             if h["failed"] is not None:
                 br.trip(now)
                 self._replace_slot(slot, reason=h["failed"])
@@ -558,6 +592,18 @@ class Router:
                 self._replace_slot(
                     slot, reason=f"stalled {h['last_dispatch_age']:.1f}s")
         self._tel.gauge("router.replicas_live").set(live)
+
+    def _note_starvation(self, replica, h):
+        """Roll each replica's DRR rotation count (service-side
+        `bucket_starvation`: dispatches where a colder bucket jumped
+        the queue head) into the router-level `bucket_starvation`
+        counter — deltas per replica NAME, so a replacement's fresh
+        zero doesn't rewind the aggregate."""
+        cur = int(h.get("bucket_starvation", 0) or 0)
+        prev = self._starvation_seen.get(replica.name, 0)
+        if cur > prev:
+            self._count("bucket_starvation", cur - prev)
+        self._starvation_seen[replica.name] = max(cur, prev)
 
     def _attribute_crashes(self, replica, suspects):
         """Feed a replica's crash_suspects (inner ids whose OWN
@@ -793,16 +839,37 @@ class Router:
             return lat[i]
         return {"p50": pct(0.50), "p99": pct(0.99)}
 
+    def _cache_stats_dicts(self):
+        """Per-replica CompileCache stats through the duck-typed
+        `cache_stats()` surface — works for thread replicas (a direct
+        stats() call) and process replicas (the last health-reported
+        dict) alike; a replica without the surface contributes
+        nothing."""
+        out = []
+        for r in self.replica_set:
+            fn = getattr(r, "cache_stats", None)
+            if fn is None:
+                continue
+            try:
+                out.append(fn())
+            except Exception:          # pragma: no cover - dead worker
+                out.append({})
+        return out
+
     def stats(self):
         """One structured snapshot for tests / bench: counters,
         breaker state machines, brownout history, latencies."""
-        from .compile_cache import merged_stats
+        from .compile_cache import merged_stats_dicts
         with self._lock:
             counts = dict(self.counts)
+        extra = {}
+        boot = getattr(self.replica_set, "boot_stats", None)
+        if boot is not None:
+            extra = boot()
         return {
             "counts": counts,
-            "compile_cache": merged_stats(
-                r.service.cache for r in self.replica_set),
+            "compile_cache": merged_stats_dicts(
+                self._cache_stats_dicts()),
             "breakers": [{"slot": i, "state": b.state,
                           "opens": b.opens,
                           "states_seen": b.states_seen()}
@@ -811,5 +878,8 @@ class Router:
             "brownout_transitions": list(self.brownout_transitions),
             "replica_restarts": self.replica_set.replacements,
             "replicas": [r.name for r in self.replica_set],
+            "replica_mode": self.options.get("serve_replica_mode",
+                                             "thread"),
+            **extra,
             **self.latency_percentiles(),
         }

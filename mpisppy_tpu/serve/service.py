@@ -36,6 +36,8 @@ Options (all prefixed `serve_`):
   serve_max_queue       queue capacity, rejects beyond       (256)
   serve_max_inflight    queued+running admission cap         (32)
   serve_max_batch       max coalesced requests per dispatch  (8)
+  serve_coalesce_window_s  batch-forming hold (seconds) while a
+                        short group's bucket is still filling (0)
   serve_default_deadline  per-request seconds (None = none)  (None)
   serve_result_timeout  result() wait when no deadline       (600)
   serve_result_grace    extra result() wait past deadline    (30)
@@ -125,6 +127,8 @@ class SolverService:
         self.max_queue = int(o.get("serve_max_queue", 256))
         self.max_inflight = int(o.get("serve_max_inflight", 32))
         self.max_batch = int(o.get("serve_max_batch", 8))
+        self.coalesce_window = float(
+            o.get("serve_coalesce_window_s", 0.0) or 0.0)
         self.default_deadline = o.get("serve_default_deadline")
         self.result_timeout = float(o.get("serve_result_timeout", 600.0))
         self.result_grace = float(o.get("serve_result_grace", 30.0))
@@ -161,6 +165,12 @@ class SolverService:
         # nobody — blaming the whole group would quarantine innocents.
         self._executing = None
         self.crash_suspects = set()
+        # deficit round-robin across compile-cache buckets: the bucket
+        # served by the previous dispatch group, and how many times the
+        # DRR rotation had to pass over the queue head (the starvation-
+        # averted signal the router aggregates)
+        self._last_bucket = None
+        self.bucket_starvation = 0
         self._backend_lock = (_BACKEND_LOCK
                               if o.get("serve_backend_lock", True)
                               else threading.Lock())
@@ -177,6 +187,11 @@ class SolverService:
         if not running:
             from ..utils.platform import enable_compile_cache
             enable_compile_cache()
+            # bound the shared AOT artifact dir before this process
+            # starts adding to it (no-op unless a limit is configured)
+            _cc.prune_aot_dir(
+                max_age_s=self.options.get("serve_aot_max_age_s"),
+                max_total_bytes=self.options.get("serve_aot_max_bytes"))
             self._spawn_worker()
         return self
 
@@ -366,6 +381,7 @@ class SolverService:
                 "last_dispatch_age": now - ref,
                 "restarts": self.restarts,
                 "crash_suspects": set(self.crash_suspects),
+                "bucket_starvation": self.bucket_starvation,
             }
 
     def result(self, handle, timeout=None):
@@ -436,9 +452,16 @@ class SolverService:
         return req.bucket
 
     def _next_group(self):
-        """Pop the oldest live request plus every same-bucket queued
-        request (up to max_batch), preserving queue order for the
-        rest.  Returns None only on drained shutdown."""
+        """Form the next dispatch group by deficit round-robin across
+        compile-cache buckets: the queued buckets (in arrival order)
+        form a ring, and each dispatch serves the bucket after the one
+        served last — so a hot bucket streaming same-shape requests
+        can't starve an interleaved cold one.  Within the chosen
+        bucket, up to max_batch requests coalesce in arrival order;
+        queue order is preserved for the rest.  Every rotation that
+        passes over the queue head counts in `bucket_starvation` (one
+        head-of-line wait averted).  Returns None only on drained
+        shutdown."""
         with self._work:
             while True:
                 now = time.monotonic()
@@ -446,21 +469,55 @@ class SolverService:
                     self._queue.remove(req)
                     self._finish_locked(
                         req, timeout_result(req, where="queued"))
-                if self._queue:
-                    break
-                if self._stopped:
-                    return None
-                self._work.wait(0.25)
-            head = self._queue.popleft()
-            group = [head]
-            skipped = []
-            while self._queue and len(group) < self.max_batch:
+                if not self._queue:
+                    if self._stopped:
+                        return None
+                    self._work.wait(0.25)
+                    continue
+                order = []
+                for r in self._queue:
+                    b = self._bucket(r)
+                    if b not in order:
+                        order.append(b)
+                pick = order[0]
+                if len(order) > 1 and self._last_bucket is not None \
+                        and self._last_bucket in order:
+                    # the ring: first queued bucket after the last-
+                    # served one; a bucket no longer queued forfeits
+                    # its slot and the turn falls back to the queue
+                    # head
+                    i = order.index(self._last_bucket)
+                    pick = order[(i + 1) % len(order)]
+                if self.coalesce_window > 0.0 and not self._stopped:
+                    # batch-forming window: requests arriving one at a
+                    # time (e.g. over the wire) would otherwise
+                    # dispatch as odd-width groups, each width a fresh
+                    # trace — hold a short group open until max_batch
+                    # fills or the window (from the group head's
+                    # arrival) expires
+                    matching = [r for r in self._queue
+                                if self._bucket(r) == pick]
+                    if len(matching) < self.max_batch:
+                        hold = (matching[0].submitted
+                                + self.coalesce_window) - now
+                        if hold > 0:
+                            self._work.wait(min(hold, 0.25))
+                            continue
+                break
+            if pick != order[0]:
+                self.bucket_starvation += 1
+                self._tel.counter("serve.bucket_starvation").inc()
+            self._last_bucket = pick
+            group = []
+            rest = []
+            while self._queue:
                 r = self._queue.popleft()
-                if self._bucket(r) == self._bucket(head):
+                if len(group) < self.max_batch \
+                        and self._bucket(r) == pick:
                     group.append(r)
                 else:
-                    skipped.append(r)
-            self._queue.extendleft(reversed(skipped))
+                    rest.append(r)
+            self._queue.extend(rest)
             for r in group:
                 r.status = RUNNING
                 self._inflight.append(r)

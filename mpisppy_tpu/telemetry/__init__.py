@@ -327,7 +327,7 @@ def router_counters(registry=None):
              "router.over_quota", "router.breaker_opens",
              "router.replica_restarts", "router.replayed_requests",
              "router.quarantined", "router.duplicate_completions",
-             "router.degraded_requests")
+             "router.degraded_requests", "router.bucket_starvation")
     vals = ({k: c.value for k, c in reg._counters.items()}
             if reg.enabled else {})
     out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}
@@ -347,7 +347,10 @@ def gateway_counters(registry=None):
     names = ("gateway.requests", "gateway.bytes_in",
              "gateway.bytes_out", "gateway.rolls", "gateway.drains",
              "cache.aot_loads", "cache.aot_load_failures",
-             "cache.aot_saves", "cache.aot_export_failures")
+             "cache.aot_saves", "cache.aot_export_failures",
+             "cache.aot_prewarm_hits", "cache.aot_evictions",
+             "client.reconnects", "client.resends",
+             "client.idle_reaped")
     vals = ({k: c.value for k, c in reg._counters.items()}
             if reg.enabled else {})
     out = {n.replace(".", "_"): int(vals.get(n, 0)) for n in names}

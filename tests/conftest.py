@@ -66,3 +66,8 @@ def pytest_configure(config):
         "markers", "net: network front-door tests (wire protocol, "
         "gateway/client over real sockets, AOT executable persistence, "
         "rolling restart); these RUN under tier-1's `-m 'not slow'`")
+    config.addinivalue_line(
+        "markers", "procserve: process-replica fleet tests (OS-process "
+        "workers over loopback sockets, SIGKILL fault paths, DRR "
+        "dispatch fairness, AOT prewarm/eviction) with a CPU-safe "
+        "small process count; these RUN under tier-1's `-m 'not slow'`")

@@ -455,6 +455,9 @@ def test_gateway_counters_stable_keys(fresh_telemetry):
                 "gateway_bytes_out", "gateway_rolls", "gateway_drains",
                 "cache_aot_loads", "cache_aot_load_failures",
                 "cache_aot_saves", "cache_aot_export_failures",
+                "cache_aot_prewarm_hits", "cache_aot_evictions",
+                "client_reconnects", "client_resends",
+                "client_idle_reaped",
                 "gateway_active_connections", "gateway_rejects_by_code"}
     assert set(cold) == expected
     assert all(v == 0 for k, v in cold.items()

@@ -161,7 +161,8 @@ def test_cache_counts_hits_and_misses():
     cache.get(farmer.build_batch(4), FAST_OPTS)
     assert cache.stats() == {"hits": 1, "misses": 2, "buckets": 2,
                              "aot_loads": 0, "aot_load_failures": 0,
-                             "aot_saves": 0, "aot_export_failures": 0}
+                             "aot_saves": 0, "aot_export_failures": 0,
+                             "aot_prewarm_hits": 0}
 
 
 # -- admission control (no dispatch thread needed) ------------------------
